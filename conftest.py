@@ -1,0 +1,22 @@
+"""Root conftest: force the JAX CPU backend with a virtual 8-device mesh.
+
+The reference's distributed tests run multi-process on one host with Gloo
+(SURVEY.md §4); the TPU-native analog is a fake 8-device CPU platform via
+``--xla_force_host_platform_device_count=8`` so mesh/sharding logic is
+exercised without real chips.  This must run before the first ``import jax``
+anywhere (the axon sitecustomize pins JAX_PLATFORMS=axon, so we re-pin to
+cpu here for the test session only; bench.py / __graft_entry__.py do NOT
+import this and keep the real TPU).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

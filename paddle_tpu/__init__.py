@@ -1,0 +1,37 @@
+"""paddle_tpu — a TPU-native deep-learning framework with a Paddle-shaped API.
+
+Built from scratch on jax/XLA/Pallas (SURVEY.md is the blueprint; the
+reference is tensor-tang/Paddle).  ``import paddle_tpu as paddle`` gives
+the familiar surface: Tensor, nn.Layer, optimizer, amp, io.DataLoader,
+distributed.fleet — all lowered to XLA with GSPMD sharding for the
+parallelism stack.
+"""
+from . import common
+from .common import dtype as _dtype_mod
+from .common.dtype import (
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    int8, int16, int32, int64, uint8, finfo, iinfo,
+)
+from .common.flags import get_flags, set_flags
+from .runtime import device
+from .runtime.device import get_device, set_device, is_compiled_with_tpu
+from .tensor import Parameter, Tensor, to_tensor
+from .autograd.tape import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from . import ops
+from .ops import *  # noqa: F401,F403  — the paddle.* op surface
+from .ops.random import seed, get_rng_state, set_rng_state
+from . import autograd
+
+# Subsystem imports land as modules are built (nn, optimizer, amp, io, jit,
+# distributed, hapi, profiler are appended below once present).
+
+# paddle API aliases
+bool = bool_  # noqa: A001
+disable_static = lambda *a, **k: None  # dygraph is the default; API parity
+enable_static = lambda *a, **k: None
+
+CPUPlace = lambda: device.Place("cpu", 0)
+TPUPlace = lambda idx=0: device.Place("tpu", idx)
+CUDAPlace = TPUPlace  # accel alias
+
+__version__ = "0.1.0"

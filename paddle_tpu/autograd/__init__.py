@@ -1,0 +1,1 @@
+from .tape import backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled

@@ -1,0 +1,223 @@
+"""Define-by-run autograd engine over jax.vjp.
+
+Reference parity: the eager autograd engine (paddle/fluid/eager/ —
+``GradNodeBase``, ``AutogradMeta``, ``egr::Backward`` with its ready-queue
+over the grad-node graph, grad-accumulation nodes, hooks).  TPU-native
+design: each eager op is executed through ``jax.vjp`` so the backward pass
+is XLA-differentiated per-op; the tape only stores the vjp closures and the
+producer graph.  Under ``jax.jit`` tracing the same machinery traces cleanly
+(jax.vjp is traceable), so compiled mode reuses this engine; the fast path
+for training compiles a pure function with ``jax.grad`` and bypasses the
+tape entirely (see jit/to_static and hapi trainer).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "backward",
+    "grad",
+]
+
+_grad_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_grad_state, "enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _grad_state.enabled = bool(mode)
+
+
+class _GradModeCtx(contextlib.ContextDecorator):
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def no_grad():
+    """``paddle.no_grad()`` — usable as context manager or decorator."""
+    return _GradModeCtx(False)
+
+
+def enable_grad():
+    return _GradModeCtx(True)
+
+
+class GradNode:
+    """One executed op on the tape.
+
+    ``vjp_fn`` maps output cotangents (matching the op's primal output
+    structure) to input cotangents, one per differentiable input.  Each
+    input edge is either another node's output (``('n', node, out_idx)``)
+    or a leaf tensor (``('l', tensor)``) whose ``.grad`` accumulates.
+    """
+
+    __slots__ = ("name", "vjp_fn", "in_edges", "n_outputs", "out_tree", "hooks")
+
+    def __init__(self, name: str, vjp_fn: Callable, in_edges: List[Tuple],
+                 n_outputs: int, out_tree):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.in_edges = in_edges
+        self.n_outputs = n_outputs
+        self.out_tree = out_tree
+        self.hooks: List[Callable] = []
+
+    def __repr__(self):
+        return f"GradNode({self.name}, n_out={self.n_outputs})"
+
+
+def _topo_order(root: GradNode) -> List[GradNode]:
+    """Iterative post-order DFS → topological order (producers first)."""
+    order: List[GradNode] = []
+    seen = set()
+    stack: List[Tuple[GradNode, bool]] = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for edge in node.in_edges:
+            if edge[0] == "n" and id(edge[1]) not in seen:
+                stack.append((edge[1], False))
+    return order
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False,
+             watch: Optional[dict] = None,
+             leaf_filter: Optional[set] = None) -> None:
+    """Run the tape backward from ``tensor``, accumulating into leaf
+    ``.grad`` slots (paddle ``Tensor.backward()`` semantics).
+
+    ``watch`` optionally maps ``(id(node), out_idx) -> Tensor`` so grads of
+    *intermediate* (non-leaf) tensors can be captured (used by
+    :func:`grad`)."""
+    from ..tensor import Tensor  # local import to avoid a cycle
+
+    watch = watch or {}
+    root_node = tensor._node
+    if root_node is None:
+        if not tensor.stop_gradient:
+            g = jnp.ones_like(tensor.value) if grad_tensor is None else (
+                grad_tensor.value if isinstance(grad_tensor, Tensor) else grad_tensor)
+            tensor._accumulate_grad(g)
+        return
+    if grad_tensor is None:
+        g0 = jnp.ones_like(tensor.value)
+    else:
+        g0 = grad_tensor.value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # cotangent accumulators: id(node) -> [ct or None] * n_outputs
+    cts = {id(root_node): [None] * root_node.n_outputs}
+    cts[id(root_node)][tensor._out_idx] = g0
+    w = watch.get((id(root_node), tensor._out_idx))
+    if w is not None:
+        w._accumulate_grad(g0)
+
+    order = _topo_order(root_node)  # producers first
+    for node in reversed(order):    # consumers first
+        node_cts = cts.get(id(node))
+        if node_cts is None:
+            continue
+        filled = [
+            ct if ct is not None else jnp.zeros(shape, dtype)
+            for ct, (shape, dtype) in zip(node_cts, node.out_tree["avals"])
+        ]
+        out_struct = jax.tree_util.tree_unflatten(node.out_tree["treedef"], filled)
+        in_cts = node.vjp_fn(out_struct)
+        for hook in node.hooks:
+            in_cts = hook(in_cts) or in_cts
+        for edge, ct in zip(node.in_edges, in_cts):
+            if ct is None:
+                continue
+            if edge[0] == "n":
+                _, producer, out_idx = edge
+                slot = cts.setdefault(id(producer), [None] * producer.n_outputs)
+                slot[out_idx] = ct if slot[out_idx] is None else slot[out_idx] + ct
+                w = watch.get((id(producer), out_idx))
+                if w is not None:
+                    w._accumulate_grad(ct)
+            else:
+                leaf = edge[1]
+                if leaf_filter is None or id(leaf) in leaf_filter:
+                    leaf._accumulate_grad(ct)
+        if not retain_graph:
+            node.vjp_fn = _freed_vjp
+        del cts[id(node)]
+
+
+def _freed_vjp(*_a, **_k):
+    raise RuntimeError(
+        "grad graph already freed; call backward(retain_graph=True) to reuse it"
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """``paddle.grad`` — functional grads w.r.t. explicit inputs.
+
+    Implemented by running the tape backward into temporary accumulators
+    instead of ``.grad`` slots.  ``create_graph`` is currently unsupported
+    on the eager tape (use the jit/compiled path for higher-order grads).
+    """
+    from ..tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph on the eager tape is unsupported; use "
+            "paddle_tpu.jit functional transforms for higher-order grads")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    saved = [(t, t._grad) for t in inputs]
+    for t in inputs:
+        t._grad = None
+    watch = {
+        (id(t._node), t._out_idx): t for t in inputs if t._node is not None
+    }
+    leaf_filter = {id(t) for t in inputs}
+    try:
+        for out, g in zip(outputs, grad_outputs):
+            backward(out, g, retain_graph=True, watch=watch,
+                     leaf_filter=leaf_filter)
+        results = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(
+                    "an input was not used in the graph (pass allow_unused=True)")
+            results.append(Tensor(t._grad) if t._grad is not None else None)
+    finally:
+        for t, old in saved:
+            t._grad = old
+    if not retain_graph:
+        for out in outputs:
+            if out._node is not None:
+                for n in _topo_order(out._node):
+                    n.vjp_fn = _freed_vjp
+    return results

@@ -1,0 +1,4 @@
+from . import dtype, errors, flags
+from .dtype import convert_dtype
+from .errors import enforce
+from .flags import define_flag, get_flag, get_flags, set_flags

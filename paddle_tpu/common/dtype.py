@@ -1,0 +1,97 @@
+"""Dtype system: paddle-shaped dtype names over jax/numpy dtypes.
+
+Reference parity: paddle's ``paddle.float32``-style dtype objects
+(paddle/phi/common/data_type.h in the reference tree; python surface
+``paddle.dtype``).  Here dtypes ARE numpy dtypes (what jax uses natively),
+exposed under the paddle names, with a converter that accepts strings,
+numpy dtypes, jax dtypes, and paddle-style ``paddle.float32`` objects.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+    "float8_e4m3fn", "float8_e5m2",
+    "convert_dtype", "is_floating_point", "is_integer", "is_complex",
+    "finfo", "iinfo", "promote_types",
+]
+
+float16 = np.dtype("float16")
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool_,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOAT_DTYPES = {float16, float32, float64, bfloat16, float8_e4m3fn, float8_e5m2}
+_INT_DTYPES = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+_COMPLEX_DTYPES = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype-like (str | np.dtype | jnp dtype | python type)."""
+    if dtype is None:
+        raise ValueError("dtype must not be None")
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        return np.dtype(dtype)
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return float32
+    return np.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOAT_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INT_DTYPES
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX_DTYPES
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))

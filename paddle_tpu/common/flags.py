@@ -1,0 +1,104 @@
+"""Typed global flag registry with environment-variable overlay.
+
+Reference parity: the three-tier config system of SURVEY.md §5 — C++ global
+flags (``PHI_DEFINE_EXPORTED_*`` in paddle/phi/core/flags.cc and
+paddle/common/flags.cc, settable via ``FLAGS_x`` env vars or
+``paddle.set_flags``).  Here it is one typed Python registry: flags are
+declared with :func:`define_flag`, overridden by ``FLAGS_<name>`` in the
+environment at definition time, and mutated at runtime via
+:func:`set_flags` / read via :func:`get_flags` (the paddle-shaped API).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Union
+
+__all__ = [
+    "define_flag",
+    "get_flag",
+    "set_flags",
+    "get_flags",
+]
+
+
+def _parse_bool(s: str) -> bool:
+    s = s.strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off", ""):
+        return False
+    raise ValueError(f"cannot parse {s!r} as bool")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: lambda s: s,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "", type: Optional[type] = None):
+    """Declare a global flag. ``FLAGS_<name>`` in the environment overrides
+    ``default`` at declaration time."""
+    if name.startswith("FLAGS_"):
+        name = name[len("FLAGS_"):]
+    ftype = type if type is not None else default.__class__
+    if ftype not in _PARSERS:
+        raise TypeError(f"flag type must be one of {list(_PARSERS)}, got {ftype}")
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = _PARSERS[ftype](env)
+    flag = _Flag(name=name, default=default, type=ftype, help=help, value=value)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def _canon(name: str) -> str:
+    return name[len("FLAGS_"):] if name.startswith("FLAGS_") else name
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY[_canon(name)].value
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags-shaped: ``set_flags({'FLAGS_check_nan_inf': 1})``."""
+    for name, value in flags.items():
+        key = _canon(name)
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        flag = _REGISTRY[key]
+        if not isinstance(value, flag.type):
+            value = _PARSERS[flag.type](str(value))
+        flag.value = value
+
+
+def get_flags(names: Union[str, List[str]]) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[_canon(n)].value for n in names}
+
+
+# ---------------------------------------------------------------------------
+# Core flags (analogs of the reference's most-used FLAGS_*)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debug)")
+define_flag("use_pallas", True, "use Pallas kernels where available (TPU)")
+define_flag("eager_jit_ops", False, "jit each eager op call (per-op cache)")
+define_flag("log_level", 0, "VLOG-style verbosity; higher = chattier")
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA owns memory")

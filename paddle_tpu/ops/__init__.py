@@ -1,0 +1,9 @@
+"""Op library (PHI equivalent): Tensor-level functional ops.
+
+``paddle_tpu.ops.<name>`` is the tensorized surface; raw jax-level
+implementations live in the ``_``-prefixed modules and are reachable via
+``fn.__wrapped_raw__`` (used by the compiled/jit paths to skip the tape).
+"""
+from . import random  # noqa: F401  (stateful RNG facade)
+from .api import *  # noqa: F401,F403
+from .api import TENSOR_METHODS, tensorize  # noqa: F401

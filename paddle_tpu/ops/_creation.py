@@ -1,0 +1,86 @@
+"""Raw creation ops (no tensor inputs — never taped).
+
+Reference parity: phi full/arange/eye/linspace kernels + paddle python
+creation API (python/paddle/tensor/creation.py signatures).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import convert_dtype
+
+
+def _dt(dtype, default="float32"):
+    return convert_dtype(dtype if dtype is not None else default)
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros([int(s) for s in shape], dtype=_dt(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones([int(s) for s in shape], dtype=_dt(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        dtype = jnp.result_type(fill_value)
+    return jnp.full([int(s) for s in shape], fill_value,
+                    dtype=convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros([int(s) for s in shape], dtype=_dt(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=None if dtype is None else _dt(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=None if dtype is None else _dt(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=None if dtype is None else _dt(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=None if dtype is None else _dt(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else "float32")
+    return jnp.arange(start, end, step, dtype=_dt(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(int(num_rows),
+                   int(num_columns) if num_columns is not None else None,
+                   dtype=_dt(dtype))
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril_indices(row, col, offset=0):
+    return jnp.stack(jnp.tril_indices(row, k=offset, m=col))
+
+
+def triu_indices(row, col, offset=0):
+    return jnp.stack(jnp.triu_indices(row, k=offset, m=col))

@@ -1,0 +1,297 @@
+"""Raw shape/layout manipulation ops.
+
+Reference parity: phi manipulation kernels (reshape, transpose, concat,
+split, gather/scatter, pad, tile/expand...) with paddle python signatures.
+All static-shape — the XLA contract (SURVEY.md §"XLA semantics").
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def reshape(x, shape):
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=[int(p) for p in perm])
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+def unstack(x, axis=0, num=None):
+    n = x.shape[axis] if num is None else num
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = builtins.sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.expand_dims(x, axis=tuple(axes))
+
+
+def expand(x, shape):
+    shape = list(shape)
+    # paddle allows -1 = keep dim
+    offset = len(shape) - x.ndim
+    out_shape = []
+    for i, s in enumerate(shape):
+        if int(s) == -1:
+            out_shape.append(x.shape[i - offset] if i >= offset else 1)
+        else:
+            out_shape.append(int(s))
+    return jnp.broadcast_to(x, out_shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, [int(s) for s in shape])
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, [int(r) for r in repeat_times])
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = (x.shape[:start]
+                 + (int(np.prod(x.shape[start:stop + 1])),)
+                 + x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+def flip(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    """paddle.nn.functional.pad — ``pad`` is per-axis [lo, hi] pairs.
+
+    Accepts either the len==2*ndim full spec (applies from last axis
+    backwards, torch/paddle style) or the NCHW/NCDHW shorthand.
+    """
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if len(pad) == 2 * nd:
+        # full form: (before_0, after_0, before_1, after_1, ...) paddle uses
+        # axis order starting from dim 0 in this form
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # shorthand: last len(pad)//2 spatial dims, torch-style from last dim
+        width = [(0, 0)] * nd
+        n = len(pad) // 2
+        for i in range(n):
+            axis = nd - 1 - i
+            width[axis] = (pad[2 * i], pad[2 * i + 1])
+    if jmode == "constant":
+        return jnp.pad(x, width, mode=jmode, constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+def cast(x, dtype):
+    from ..common.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype))
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape) if np.ndim(values) == 0 \
+        else values
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis,
+                                  inplace=False)
+    dim_idx = [jnp.arange(s).reshape(
+        [1] * i + [s] + [1] * (arr.ndim - i - 1)) for i, s in
+        enumerate(indices.shape)]
+    full_idx = tuple(indices if d == axis else
+                     jnp.broadcast_to(dim_idx[d], indices.shape)
+                     for d in range(arr.ndim))
+    if reduce in ("add", "sum"):
+        return arr.at[full_idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return arr.at[full_idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def scatter(x, index, updates, overwrite=True):
+    """paddle.scatter — writes ``updates`` rows at ``index`` along axis 0."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    return x.at[(builtins.slice(None),) * axis + (index,)].add(value)
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+def slice(x, axes, starts, ends):
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(st, en)
+    return x[tuple(sl)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, stp in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(st, en, stp)
+    return x[tuple(sl)]
+
+
+def getitem(x, idx):
+    return x[idx]
+
+
+def setitem(x, v, idx):
+    return x.at[idx].set(v)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset=0, padding_value=0.0):
+    if x.ndim == 1 and padding_value != 0.0:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return out.at[jnp.arange(x.shape[0]),
+                      jnp.arange(x.shape[0]) + offset].set(x) if offset >= 0 \
+            else out.at[jnp.arange(x.shape[0]) - offset,
+                        jnp.arange(x.shape[0])].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def meshgrid(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)

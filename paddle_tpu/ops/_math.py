@@ -1,0 +1,388 @@
+"""Raw elementwise / binary / matmul ops (jax level).
+
+Reference parity: phi kernels — paddle/phi/kernels/{cpu,gpu}/ elementwise,
+activation, and matmul kernels plus their ops.yaml signatures.  Each
+function here is a pure jax function with the paddle python-API signature;
+the Tensor-level wrappers are generated in ops/api.py.  XLA fuses these
+into surrounding computations, which is the TPU analog of phi's fused
+elementwise CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import dtype as dtypes
+
+
+# -- binary -----------------------------------------------------------------
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+def mod(x, y):
+    return jnp.remainder(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        raise NotImplementedError("scale(act=...) unsupported")
+    return out
+
+
+# -- unary ------------------------------------------------------------------
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# -- logical / bitwise ------------------------------------------------------
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# -- matmul family ----------------------------------------------------------
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    """paddle.matmul — batched matmul with optional transposes.
+
+    bf16/fp16 inputs accumulate in f32 on the MXU via
+    ``preferred_element_type`` (the TPU analog of cuBLAS fp32 compute).
+    """
+    if transpose_x:
+        if x.ndim == 1:
+            pass
+        else:
+            x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        if y.ndim == 1:
+            pass
+        else:
+            y = jnp.swapaxes(y, -1, -2)
+    acc = None
+    if x.dtype in (jnp.bfloat16, jnp.float16) and y.dtype == x.dtype:
+        acc = jnp.float32
+    out = jnp.matmul(x, y, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    return out
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * matmul(x, y)
+
+
+def multiply_(x, y):
+    return jnp.multiply(x, y)

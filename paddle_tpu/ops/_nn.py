@@ -1,0 +1,656 @@
+"""Raw neural-network ops.
+
+Reference parity: phi activation/norm/conv/softmax/embedding/loss kernels
+(paddle/phi/kernels — incl. gpudnn conv, fusion/fused attention) exposed
+with paddle.nn.functional signatures (python/paddle/nn/functional/*).
+
+TPU-native notes: convs lower to XLA ``conv_general_dilated`` (MXU);
+attention has a fused Pallas path (ops/pallas/flash_attention.py) selected
+by ``FLAGS_use_pallas`` on TPU, with this jnp reference as fallback and
+as the numerics oracle in tests.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..common.dtype import convert_dtype
+from . import random as _random
+
+# -- activations ------------------------------------------------------------
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def hardswish(x):
+    return jax.nn.hard_swish(x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jax.nn.softplus(beta * x) / beta
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    g = _random.gumbel(x.shape).astype(x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                    inplace=False)
+        y = y_hard + lax.stop_gradient(-y) + y  # straight-through
+    return y
+
+
+# -- linear / embedding -----------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """paddle F.linear: weight is [in_features, out_features] (NOT torch's
+    transposed layout) — x @ W + b."""
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, weight, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+# -- normalization ----------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    axes = tuple(range(x.ndim - len(list(normalized_shape)), x.ndim))
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (Llama-family). f32 statistics regardless of input dtype."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = (xf * lax.rsqrt(ms + epsilon)).astype(dt)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    dt = x.dtype
+    xf = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, *spatial)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight.reshape(1, c, *([1] * len(spatial)))
+    if bias is not None:
+        out = out + bias.reshape(1, c, *([1] * len(spatial)))
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    c = x.shape[1]
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    """Returns (out, new_running_mean, new_running_var); the Layer wrapper
+    owns the running-stat mutation (functional purity for jit)."""
+    caxis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    shape = tuple(x.shape[caxis] if i == caxis else 1 for i in range(x.ndim))
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_rm, new_rv
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+# -- dropout ----------------------------------------------------------------
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_random.split_key(), keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# -- convolution / pooling --------------------------------------------------
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _conv_padding(padding, n, stride, dilation, ksize):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """paddle F.conv2d: weight [C_out, C_in/groups, kH, kW]."""
+    n = 2
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n, stride, dilation, weight.shape[2:])
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    x4 = x[:, :, None, :] if data_format == "NCL" else x[:, None, :, :]
+    w4 = weight[:, :, None, :]
+    stride = _norm_tuple(stride, 1)
+    dilation = _norm_tuple(dilation, 1)
+    if isinstance(padding, str):
+        pad = padding
+    elif isinstance(padding, int):
+        pad = [0, padding]
+    else:
+        pad = [0] + list(padding)
+    out = conv2d(x4, w4, bias, (1, stride[0]), pad, (1, dilation[0]), groups,
+                 "NCHW")
+    return out[:, :, 0, :] if data_format == "NCL" else out[:, 0, :, :]
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    n = 3
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _conv_padding(padding, n, stride, dilation, weight.shape[2:])
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    """weight [C_in, C_out/groups, kH, kW] (paddle conv_transpose layout)."""
+    n = 2
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    output_padding = _norm_tuple(output_padding, n)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    padv = _norm_tuple(padding, n) if not isinstance(padding, (list, tuple)) \
+        or all(isinstance(p, int) for p in padding) else padding
+    if isinstance(padv[0], int):
+        padv = [(p, p) for p in padv]
+    k = weight.shape[2:]
+    # transpose-conv as lhs-dilated conv with flipped kernel
+    pad_trans = []
+    for i in range(n):
+        eff_k = (k[i] - 1) * dilation[i] + 1
+        lo = eff_k - 1 - padv[i][0]
+        hi = eff_k - 1 - padv[i][1] + output_padding[i]
+        pad_trans.append((lo, hi))
+    w = jnp.flip(weight, axis=(-2, -1))
+    # [C_in, C_out/g, kH, kW] -> grouped: out channels = C_out
+    cin, cog = weight.shape[0], weight.shape[1]
+    w = w.reshape(groups, cin // groups, cog, *k)
+    w = jnp.moveaxis(w, 2, 1).reshape(groups * cog, cin // groups, *k)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad_trans,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    n = 2
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _conv_padding(padding, n, s, (1, 1), k)
+    if isinstance(p, str):
+        pads = p
+    else:
+        pads = [(0, 0), (0, 0)] + list(p)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    out = lax.reduce_window(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                            else jnp.iinfo(x.dtype).min,
+                            lax.max, dims, strides, pads)
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    n = 2
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _conv_padding(padding, n, s, (1, 1), k)
+    pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive and not isinstance(pads, str):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out = _norm_tuple(output_size, 2)
+    h, w = x.shape[2], x.shape[3]
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return avg_pool2d(x, (kh, kw), (kh, kw), 0)
+    raise NotImplementedError("adaptive pool with non-divisible sizes")
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    out = _norm_tuple(output_size, 2)
+    h, w = x.shape[2], x.shape[3]
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return max_pool2d(x, (kh, kw), (kh, kw), 0)
+    raise NotImplementedError("adaptive pool with non-divisible sizes")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    n, c, h, w = x.shape
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _norm_tuple(paddings, 2)
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding="VALID",
+        rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _norm_tuple(scale_factor, 2) if not isinstance(scale_factor, (int, float)) \
+            else (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = _norm_tuple(size, 2)
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "bilinear",
+              "bicubic": "bicubic", "area": "linear"}[mode]
+    xt = jnp.moveaxis(x, 1, -1)
+    out = jax.image.resize(xt, (n, size[0], size[1], c), method=method)
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+# -- attention --------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None):
+    """Reference (jnp) attention: q/k/v are [B, S, H, D] (paddle layout).
+
+    The fused Pallas flash-attention path (ops/pallas) supersedes this on
+    TPU; this is the numerics oracle and CPU fallback.
+    """
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    q = jnp.moveaxis(query, 2, 1)  # B H S D
+    k = jnp.moveaxis(key, 2, 1)
+    v = jnp.moveaxis(value, 2, 1)
+    if k.shape[1] != h:  # GQA: repeat kv heads
+        rep = h // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.moveaxis(out, 1, 2)  # back to B S H D
+
+
+# -- losses -----------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """paddle F.cross_entropy: input = logits (use_softmax=True default)."""
+    if use_softmax:
+        logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input.astype(jnp.float32), 1e-30, None))
+    nclass = input.shape[axis]
+    if soft_label:
+        lbl = label.astype(jnp.float32)
+        loss = -jnp.sum(lbl * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == logp.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(lbl, nclass, axis=axis)
+            smoothed = onehot * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(smoothed * logp, axis=axis)
+        else:
+            lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(lbl_safe, axis), axis=axis
+            ).squeeze(axis)
+        valid = (lbl != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if weight is not None:
+            w = jnp.take(weight, jnp.where(lbl == ignore_index, 0, lbl))
+            w = jnp.where(valid, w, 0.0)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean" and valid is not None:
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    loss = -jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    x = logit.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    mx = jnp.clip(x, 0, None)
+    loss = mx - x * lbl + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1) * lbl + 1
+        loss = loss * log_weight  # approximation consistent at extremes
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                     jnp.abs(d) - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-30, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is None:
+        return (1 - epsilon) * label + epsilon / n
+    return (1 - epsilon) * label + epsilon * prior_dist

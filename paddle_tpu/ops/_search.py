@@ -1,0 +1,138 @@
+"""Raw search/sort/sampling ops.
+
+Reference parity: phi kernels argmax/argmin/top_k/sort/where/masked_select
+/unique/nonzero (paddle/phi/kernels + python/paddle/tensor/search.py).
+Note: ``nonzero``/``masked_select`` produce data-dependent shapes, which
+XLA cannot compile — they are eager-only ops (documented; the reference's
+dynamic-shape ops hit the same wall in CINN).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.int32) if str(dtype) in ("int32", "int64") else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.int32)
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int32)
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    k = int(k)
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        vals, idx = topk(xm, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    if largest:
+        vals, idx = jax.lax.top_k(x, k)
+    else:
+        vals, idx = jax.lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx.astype(jnp.int32)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    taken_i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_i = jnp.expand_dims(taken_i, axis)
+    return taken, taken_i.astype(jnp.int32)
+
+
+def mode(x, axis=-1, keepdim=False):
+    raise NotImplementedError("paddle.mode: not yet implemented")
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent shape: eager-only (host sync)
+    idx = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i.astype(np.int32)) for i in idx)
+    return jnp.asarray(np.stack(idx, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask):
+    # data-dependent shape: eager-only
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    # data-dependent shape: eager-only
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    arr = np.asarray(x)
+    if axis is not None or arr.ndim != 1:
+        raise NotImplementedError("unique_consecutive: only 1-D supported")
+    keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    out = [jnp.asarray(arr[keep])]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        out.append(jnp.asarray(np.diff(np.append(idx, arr.size))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int32)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(np.asarray(x), weights=weights, minlength=minlength,
+                        length=None)
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    arr = np.asarray(x)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi),
+                           weights=None if weight is None else np.asarray(weight),
+                           density=density)
+    return jnp.asarray(hist if density else hist.astype(np.int64))

@@ -1,0 +1,170 @@
+"""Tensor-level op API: wraps every raw jax-level op with tape dispatch.
+
+Reference parity: the generated eager API layer — paddle's
+``eager_op_function.cc`` / ``_C_ops.*`` + python/paddle/tensor method
+registration (the reference generates these from ops.yaml; here the raw
+modules are the single source of truth and this module auto-tensorizes
+them, which is the same codegen idea executed at import time).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict
+
+from ..tensor import Tensor, apply_op, to_tensor
+from . import _creation, _linalg, _manipulation, _math, _nn, _reduction, _search
+from . import random as _random
+
+__all__ = ["TENSOR_METHODS", "tensorize"]
+
+
+def tensorize(raw: Callable) -> Callable:
+    @functools.wraps(raw)
+    def fn(*args, **kwargs):
+        return apply_op(raw, *args, **kwargs)
+    fn.__wrapped_raw__ = raw
+    return fn
+
+
+def _export(module, namespace, skip=()):
+    names = []
+    for name, obj in vars(module).items():
+        if name.startswith("_") or name in skip or not callable(obj):
+            continue
+        if not inspect.isfunction(obj) or obj.__module__ != module.__name__:
+            continue
+        namespace[name] = tensorize(obj)
+        names.append(name)
+    return names
+
+
+_NS: Dict[str, Callable] = {}
+for _mod in (_math, _reduction, _manipulation, _creation, _search, _linalg,
+             _nn):
+    _export(_mod, _NS)
+# random ops keep their stateful raw forms but still return Tensors
+for _name in ("rand", "randn", "randint", "uniform", "normal",
+              "standard_normal", "bernoulli", "multinomial", "randperm",
+              "shuffle", "gumbel", "gumbel_softmax"):
+    if hasattr(_random, _name):
+        _NS[_name] = tensorize(getattr(_random, _name))
+
+globals().update(_NS)
+__all__ += sorted(_NS)
+
+# ---------------------------------------------------------------------------
+# Tensor method installation (paddle tensor-method surface)
+# ---------------------------------------------------------------------------
+TENSOR_METHODS: Dict[str, Callable] = {}
+
+_METHOD_NAMES = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "maximum", "minimum", "exp", "log", "log2", "log10",
+    "log1p", "sqrt", "rsqrt", "square", "abs", "neg", "sign", "reciprocal",
+    "floor", "ceil", "round", "trunc", "sin", "cos", "tan", "tanh",
+    "sigmoid", "erf", "clip", "isnan", "isinf", "isfinite", "scale",
+    "matmul", "dot", "mm", "bmm", "inner", "outer", "lerp",
+    # logical
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "allclose", "isclose", "equal_all",
+    # reduction
+    "sum", "mean", "max", "min", "prod", "std", "var", "median", "nanmean",
+    "nansum", "logsumexp", "all", "any", "cumsum", "cumprod",
+    "count_nonzero", "trace",
+    # manipulation
+    "reshape", "transpose", "concat", "split", "chunk", "squeeze",
+    "unsqueeze", "expand", "broadcast_to", "expand_as", "tile", "flatten",
+    "flip", "roll", "gather", "gather_nd", "take_along_axis",
+    "put_along_axis", "scatter", "scatter_nd_add", "index_select",
+    "index_add", "tril", "triu", "diag", "diagonal", "repeat_interleave",
+    "unbind", "unstack", "cast", "real", "imag", "swapaxes", "moveaxis",
+    "masked_fill", "masked_select", "index_sample",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "where",
+    "nonzero", "unique", "searchsorted", "bincount",
+    # linalg
+    "norm", "cholesky", "det", "einsum",
+    # creation-likes
+    "zeros_like", "ones_like", "full_like",
+]
+
+for _name in _METHOD_NAMES:
+    if _name in _NS:
+        TENSOR_METHODS[_name] = _NS[_name]
+
+
+def equal_all(x, y):
+    import jax.numpy as jnp
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+_NS["equal_all"] = equal_all
+TENSOR_METHODS["equal_all"] = equal_all
+
+
+for _name in ("add", "subtract", "multiply", "divide", "clip", "scale",
+              "exp", "sqrt", "reciprocal", "floor", "ceil", "round",
+              "squeeze", "unsqueeze", "cast", "tanh"):
+    _f = TENSOR_METHODS[_name]
+
+    def _mk(f):
+        def inplace(self, *args, **kwargs):
+            self._replace_from(f(self, *args, **kwargs))
+            return self
+        return inplace
+    TENSOR_METHODS[_name + "_"] = _mk(_f)
+
+
+def fill_(self, value):
+    import jax.numpy as jnp
+    self.set_value(jnp.full(self.value.shape, value, dtype=self.value.dtype))
+    return self
+
+
+def zero_(self):
+    return fill_(self, 0.0)
+
+
+TENSOR_METHODS["fill_"] = fill_
+TENSOR_METHODS["zero_"] = zero_
+
+
+# -- operator overloads ------------------------------------------------------
+
+def _install_operators():
+    ns = _NS
+
+    def binop(name, reflected=False):
+        f = ns[name]
+        if reflected:
+            return lambda self, other: f(to_tensor(other) if not isinstance(
+                other, Tensor) else other, self)
+        return lambda self, other: f(self, other)
+
+    ops_map = {
+        "__add__": binop("add"), "__radd__": binop("add", True),
+        "__sub__": binop("subtract"), "__rsub__": binop("subtract", True),
+        "__mul__": binop("multiply"), "__rmul__": binop("multiply", True),
+        "__truediv__": binop("divide"), "__rtruediv__": binop("divide", True),
+        "__floordiv__": binop("floor_divide"),
+        "__rfloordiv__": binop("floor_divide", True),
+        "__mod__": binop("remainder"), "__rmod__": binop("remainder", True),
+        "__pow__": binop("pow"), "__rpow__": binop("pow", True),
+        "__neg__": lambda self: ns["neg"](self),
+        "__abs__": lambda self: ns["abs"](self),
+        "__invert__": lambda self: ns["logical_not"](self),
+        "__eq__": binop("equal"), "__ne__": binop("not_equal"),
+        "__lt__": binop("less_than"), "__le__": binop("less_equal"),
+        "__gt__": binop("greater_than"), "__ge__": binop("greater_equal"),
+        "__and__": binop("bitwise_and"), "__or__": binop("bitwise_or"),
+        "__xor__": binop("bitwise_xor"),
+    }
+    for dunder, impl in ops_map.items():
+        setattr(Tensor, dunder, impl)
+
+
+_install_operators()

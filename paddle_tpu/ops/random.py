@@ -1,0 +1,200 @@
+"""Stateful RNG facade over jax.random.
+
+Reference parity: paddle's global generator (``paddle.seed``, phi
+Generator per device) and the fleet RNG-state tracker used for TP dropout
+determinism (fleet/meta_parallel/parallel_layers/random.py).
+
+TPU-native design: a global splittable key.  Eager random ops split the
+global key; inside a compiled step a :func:`rng_guard` context supplies a
+traced per-step key so dropout masks differ per step AND stay functional
+(the trainer threads the key).  ``RNGStatesTracker`` reproduces the fleet
+API for TP-parallel dropout determinism by deterministic per-name folds.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import convert_dtype
+
+__all__ = [
+    "seed", "get_rng_state", "set_rng_state", "split_key", "rng_guard",
+    "rand", "randn", "randint", "uniform", "normal", "standard_normal",
+    "bernoulli", "multinomial", "randperm", "shuffle", "gumbel",
+    "RNGStatesTracker", "get_rng_state_tracker",
+]
+
+_state = threading.local()
+
+
+def _global_key():
+    key = getattr(_state, "key", None)
+    if key is None:
+        key = jax.random.key(0)
+        _state.key = key
+    return key
+
+
+def seed(s: int):
+    """paddle.seed — reset the global generator."""
+    _state.key = jax.random.key(int(s))
+    return None
+
+
+def get_rng_state():
+    return jax.random.key_data(_global_key())
+
+
+def set_rng_state(state):
+    _state.key = jax.random.wrap_key_data(jnp.asarray(state))
+
+
+class _KeyBox:
+    """Mutable key holder for rng_guard contexts (traced keys allowed)."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def split(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Route all random ops inside the context to splits of ``key``.
+
+    Used by the compiled training path: the step function receives a key
+    argument and wraps the model call so dropout etc. stay functional."""
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    box = _KeyBox(key)
+    prev = getattr(_state, "box", None)
+    _state.box = box
+    try:
+        yield box
+    finally:
+        _state.box = prev
+
+
+def split_key():
+    """Get a fresh subkey (from the active rng_guard, else the global key)."""
+    box = getattr(_state, "box", None)
+    if box is not None:
+        return box.split()
+    key, sub = jax.random.split(_global_key())
+    _state.key = key
+    return sub
+
+
+# -- ops --------------------------------------------------------------------
+
+def rand(shape, dtype=None):
+    return jax.random.uniform(split_key(), [int(s) for s in shape],
+                              dtype=convert_dtype(dtype or "float32"))
+
+
+def randn(shape, dtype=None):
+    return jax.random.normal(split_key(), [int(s) for s in shape],
+                             dtype=convert_dtype(dtype or "float32"))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(split_key(), [int(s) for s in shape], low, high,
+                              dtype=jnp.int32)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    return jax.random.uniform(split_key(), [int(s) for s in shape],
+                              dtype=convert_dtype(dtype or "float32"),
+                              minval=min, maxval=max)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = np.broadcast_shapes(np.shape(mean), np.shape(std))
+    out = jax.random.normal(split_key(), [int(s) for s in shape])
+    return out * std + mean
+
+
+def gumbel(shape, dtype=None):
+    return jax.random.gumbel(split_key(), [int(s) for s in shape],
+                             dtype=convert_dtype(dtype or "float32"))
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(split_key(), p=x, shape=x.shape).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = split_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*x.shape[:-1], num_samples))
+        return out.astype(jnp.int32)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int32)
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(split_key(), int(n)).astype(jnp.int32)
+
+
+def shuffle(x, axis=0):
+    return jax.random.permutation(split_key(), x, axis=axis,
+                                  independent=False)
+
+
+# -- fleet-style RNG state tracker (TP dropout determinism) -----------------
+
+class RNGStatesTracker:
+    """Named RNG streams: ``add`` registers a seed, ``rng_state(name)``
+    scopes random ops to that stream (fleet parallel_layers/random.py)."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name: str, seed_: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = jax.random.key(int(seed_))
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._states:
+            raise ValueError(f"rng state {name!r} not added")
+        box = _KeyBox(self._states[name])
+        prev = getattr(_state, "box", None)
+        _state.box = box
+        try:
+            yield
+        finally:
+            self._states[name] = box.key
+            _state.box = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

@@ -1,0 +1,9 @@
+from .device import (
+    Place,
+    current_place,
+    device_count,
+    get_all_devices,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)

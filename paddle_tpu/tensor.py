@@ -1,0 +1,382 @@
+"""Eager Tensor front-end over jax.Array.
+
+Reference parity: paddle's dygraph ``Tensor`` (C++ ``paddle::Tensor`` over
+phi DenseTensor, exposed through pybind eager_op_function / `_C_ops`) —
+define-by-run UX with ``stop_gradient`` semantics, ``.grad`` accumulation,
+``backward()``, in-place value assignment, and the full operator surface.
+
+TPU-native design: a Tensor *wraps* a ``jax.Array`` (or a tracer under
+``jax.jit``), ops dispatch through :func:`apply_op` which records the
+autograd tape via ``jax.vjp``.  Because every raw op is a pure jax function
+the same Tensor code traces cleanly inside ``jax.jit`` — the compiled
+training path reuses this class with tracers inside.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import tape
+from .common import dtype as dtypes
+from .common.errors import InvalidArgumentError, enforce
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply_op"]
+
+Array = jax.Array
+
+
+def _as_array(x, dtype=None):
+    if isinstance(x, Tensor):
+        x = x.value
+    if dtype is not None:
+        dtype = dtypes.convert_dtype(dtype)
+    return jnp.asarray(x, dtype=dtype)
+
+
+class Tensor:
+    """Paddle-shaped eager tensor. ``stop_gradient`` defaults to True
+    (paddle semantics); ``Parameter`` flips it to False."""
+
+    __slots__ = ("_value", "_stop_gradient", "_grad", "_node", "_out_idx",
+                 "name", "__weakref__")
+
+    def __init__(self, value, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        self._value = _as_array(value, dtype)
+        self._stop_gradient = stop_gradient
+        self._grad: Optional[Array] = None
+        self._node: Optional[tape.GradNode] = None
+        self._out_idx: int = 0
+        self.name = name
+
+    # -- core properties ----------------------------------------------------
+    @property
+    def value(self) -> Array:
+        return self._value
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return Tensor(self._grad) if self._grad is not None else None
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = None if g is None else _as_array(g)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        return next(iter(devs())) if callable(devs) else None
+
+    @property
+    def T(self) -> "Tensor":
+        from . import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        tape.backward(self, grad_tensor, retain_graph)
+
+    def _accumulate_grad(self, g: Array):
+        if g.dtype != self._value.dtype:
+            g = g.astype(self._value.dtype)
+        self._grad = g if self._grad is None else self._grad + g
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self):  # paddle spells both
+        self._grad = None
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True)
+
+    def register_hook(self, hook):
+        """Gradient hook on this tensor's producing edge (leaf only for now)."""
+        raise NotImplementedError("per-tensor grad hooks land with nn hooks")
+
+    # -- value access / mutation -------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        enforce(self.size == 1, "item() requires a single-element tensor")
+        return self._value.reshape(()).item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def set_value(self, value):
+        """In-place value replacement (optimizer update path). Detaches from
+        any recorded graph — matches paddle's ``tensor.set_value``."""
+        new = _as_array(value)
+        enforce(tuple(new.shape) == tuple(self._value.shape),
+                f"set_value shape mismatch {new.shape} vs {self._value.shape}")
+        self._value = new.astype(self._value.dtype)
+        self._node = None
+        self._out_idx = 0
+
+    def copy_(self, other):
+        self.set_value(other.value if isinstance(other, Tensor) else other)
+        return self
+
+    def _replace_from(self, t: "Tensor"):
+        """Adopt another tensor's value & graph linkage (in-place op support)."""
+        self._value = t._value
+        self._node = t._node
+        self._out_idx = t._out_idx
+        self._stop_gradient = t._stop_gradient
+
+    def to(self, device=None, dtype=None):
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .runtime.device import _parse
+            arr = jax.device_put(out._value, _parse(str(device)).jax_device)
+            t = Tensor(arr, stop_gradient=out._stop_gradient)
+            t._node, t._out_idx = out._node, out._out_idx
+            out = t
+        return out
+
+    def cpu(self):
+        return self.to(device="cpu")
+
+    def cuda(self):  # paddle API name; maps to the accelerator
+        return self.to(device="tpu")
+
+    def pin_memory(self):
+        return self
+
+    def clone(self) -> "Tensor":
+        from . import ops
+        return ops.assign(self)
+
+    def astype(self, dtype) -> "Tensor":
+        from . import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        enforce(self.ndim > 0, "len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        prefix = "Parameter" if isinstance(self, Parameter) else "Tensor"
+        return (f"{prefix}(shape={self.shape}, dtype={self.dtype.name}, "
+                f"stop_gradient={self._stop_gradient},\n{self._value})")
+
+    def __bool__(self):
+        enforce(self.size == 1, "truth value of multi-element tensor is ambiguous")
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._value
+
+    def __getitem__(self, idx):
+        from . import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, v):
+        from . import ops
+        self._replace_from(ops.setitem(self, v, idx))
+
+    def __hash__(self):
+        return id(self)
+
+    # arithmetic — filled in by ops.api._install_tensor_methods
+    def __matmul__(self, other):
+        from . import ops
+        return ops.matmul(self, other)
+
+    def __rmatmul__(self, other):
+        from . import ops
+        return ops.matmul(other, self)
+
+    def __getattr__(self, name):
+        # Fallback: expose registered ops as methods (paddle tensor methods
+        # like x.sum(), x.reshape(...) are installed explicitly; this covers
+        # the long tail).
+        from .ops import api
+        fn = api.TENSOR_METHODS.get(name)
+        if fn is None:
+            raise AttributeError(f"'Tensor' object has no attribute {name!r}")
+        return lambda *a, **k: fn(self, *a, **k)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: ``stop_gradient=False`` by default, carries
+    a ``trainable`` switch (paddle ``ParamBase``)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, value, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` analog."""
+    if isinstance(data, Tensor):
+        t = Tensor(data.value, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    arr = _as_array(data, dtype)
+    if place is not None:
+        from .runtime.device import _parse
+        arr = jax.device_put(arr, _parse(str(place)).jax_device)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# Op dispatch: raw jax fn -> eager Tensor call with tape recording
+# ---------------------------------------------------------------------------
+
+def _is_arraylike(a) -> bool:
+    return isinstance(a, (Tensor, jax.Array)) or (
+        isinstance(a, np.ndarray) and a.dtype != object)
+
+
+def _differentiable(x, arr) -> bool:
+    return (isinstance(x, Tensor) and not x.stop_gradient
+            and dtypes.is_floating_point(arr.dtype))
+
+
+def apply_op(raw_fn, *args, **kwargs):
+    """Execute a raw jax-level op on Tensor/array args.
+
+    Positional args that are Tensors/arrays (or non-empty lists of them)
+    are tensor inputs; everything else (and all kwargs) is static.  If any
+    tensor input requires grad and grad mode is on, runs through
+    ``jax.vjp`` and records a GradNode.
+    """
+    template: List[Tuple[str, Any]] = []
+    leaves: List[Any] = []
+    for a in args:
+        if _is_arraylike(a):
+            template.append(("t", None))
+            leaves.append(a)
+        elif isinstance(a, (list, tuple)) and len(a) > 0 and all(
+                _is_arraylike(x) for x in a):
+            template.append(("tl", len(a)))
+            leaves.extend(a)
+        else:
+            template.append(("s", a))
+
+    arrays = [x.value if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in leaves]
+
+    def rebuild(arrs):
+        it = iter(arrs)
+        out = []
+        for kind, v in template:
+            if kind == "t":
+                out.append(next(it))
+            elif kind == "tl":
+                out.append([next(it) for _ in range(v)])
+            else:
+                out.append(v)
+        return out
+
+    diff_idx = [i for i, x in enumerate(leaves)
+                if tape.is_grad_enabled() and _differentiable(x, arrays[i])]
+
+    if not diff_idx:
+        out = raw_fn(*rebuild(arrays), **kwargs)
+        return _wrap_out(out, node=None)
+
+    def f(*diff_arrays):
+        full = list(arrays)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_arrays[j]
+        return raw_fn(*rebuild(full), **kwargs)
+
+    primal, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+
+    flat, treedef = jax.tree_util.tree_flatten(primal)
+    out_tree = {
+        "treedef": treedef,
+        "avals": [(x.shape, x.dtype) for x in flat],
+    }
+    in_edges = []
+    for i in diff_idx:
+        src = leaves[i]
+        if isinstance(src, Tensor) and src._node is not None:
+            in_edges.append(("n", src._node, src._out_idx))
+        else:
+            in_edges.append(("l", src))
+    node = tape.GradNode(getattr(raw_fn, "__name__", "op"), vjp_fn,
+                         in_edges, len(flat), out_tree)
+    return _wrap_out(primal, node=node)
+
+
+def _wrap_out(out, node):
+    flat, treedef = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    for i, arr in enumerate(flat):
+        t = Tensor(arr, stop_gradient=(node is None))
+        if node is not None:
+            t._node = node
+            t._out_idx = i
+            # non-float outputs (e.g. argmax index of a max op) carry no grad
+            if not dtypes.is_floating_point(t.dtype):
+                t._stop_gradient = True
+        wrapped.append(t)
+    res = jax.tree_util.tree_unflatten(treedef, wrapped)
+    return res

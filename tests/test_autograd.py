@@ -1,0 +1,191 @@
+"""Autograd tape tests: analytic grads vs numeric finite differences.
+
+Mirrors the reference OpTest ``check_grad`` (numeric FD vs analytic —
+SURVEY.md §4) plus paddle dygraph backward semantics (stop_gradient,
+accumulation, clear_grad, paddle.grad, no_grad).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-valued f at x (numpy)."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = f(x)
+        flat[i] = old - eps
+        fm = f(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, analytic_grad, rtol=1e-2, atol=1e-3):
+    def f(xv):
+        return float(op(paddle.to_tensor(xv.astype(np.float32))).numpy())
+    ng = numeric_grad(f, x_np.astype(np.float64).copy())
+    np.testing.assert_allclose(analytic_grad, ng, rtol=rtol, atol=atol)
+
+
+class TestBackwardBasics:
+    def test_simple_chain(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=True)
+        w = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * w).sum()
+        y.backward()
+        assert x.grad is None
+        assert w.grad is not None
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_multi_use_fanout(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x + x * 3
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])  # 2x + 3
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            y = (x * 5).sum()
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_matmul_grad(self):
+        a_np = np.random.randn(3, 4).astype(np.float32)
+        b_np = np.random.randn(4, 2).astype(np.float32)
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(),
+                                   np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 4.0])
+
+
+class TestNumericGradChecks:
+    @pytest.mark.parametrize("name,op", [
+        ("exp", lambda x: paddle.exp(x).sum()),
+        ("log", lambda x: paddle.log(x + 3.0).sum()),
+        ("sqrt", lambda x: paddle.sqrt(x + 3.0).sum()),
+        ("tanh", lambda x: paddle.tanh(x).sum()),
+        ("sigmoid", lambda x: paddle.ops.sigmoid(x).sum()),
+        ("square_mean", lambda x: paddle.mean(x * x)),
+        ("softmax", lambda x: (paddle.ops.softmax(x) * paddle.ops.softmax(x)).sum()),
+        ("logsumexp", lambda x: paddle.logsumexp(x)),
+        ("norm", lambda x: paddle.norm(x + 2.0)),
+    ])
+    def test_unary_grads(self, name, op):
+        x_np = np.random.randn(6).astype(np.float32) * 0.5
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        op(x).backward()
+        check_grad(op, x_np, x.grad.numpy())
+
+    def test_reduction_grads(self):
+        x_np = np.random.randn(3, 4).astype(np.float32)
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        paddle.max(x).backward()
+        assert x.grad.numpy().sum() == pytest.approx(1.0)
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+        x[2:4].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 0, 1, 1, 0, 0])
+
+    def test_concat_grad(self):
+        a = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        (paddle.concat([a, b]) * paddle.to_tensor(
+            np.array([1, 2, 3, 4, 5], np.float32))).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [1, 2])
+        np.testing.assert_allclose(b.grad.numpy(), [3, 4, 5])
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_intermediate(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        h = x * x          # intermediate
+        y = h * 3
+        (gh,) = paddle.grad(y, h, retain_graph=True)
+        np.testing.assert_allclose(gh.numpy(), [3.0])
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        z = paddle.to_tensor(np.ones(1, np.float32), stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+class TestTensorSemantics:
+    def test_parameter_defaults(self):
+        p = paddle.Parameter(np.zeros((2, 2), np.float32))
+        assert not p.stop_gradient
+        assert p.trainable
+
+    def test_detach(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = (y * x).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_set_value_detaches(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = x * 2
+        y.set_value(np.zeros(2, np.float32))
+        assert y._node is None
+
+    def test_item_and_shape(self):
+        x = paddle.to_tensor(np.array(3.5, np.float32))
+        assert x.item() == pytest.approx(3.5)
+        assert paddle.ones([2, 3]).shape == [2, 3]
+        assert paddle.ones([2, 3]).ndim == 2
+        assert paddle.ones([2, 3]).size == 6
+
+    def test_inplace_add_(self):
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        x.add_(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(x.numpy(), [2.0, 2.0])
