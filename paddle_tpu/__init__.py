@@ -21,8 +21,12 @@ from . import ops
 from .ops import *  # noqa: F401,F403  — the paddle.* op surface
 from .ops.random import seed, get_rng_state, set_rng_state
 from . import autograd
+from . import nn
+from . import optimizer
+from .nn.initializer import ParamAttr
+from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
-# Subsystem imports land as modules are built (nn, optimizer, amp, io, jit,
+# Subsystem imports land as modules are built (amp, io, jit,
 # distributed, hapi, profiler are appended below once present).
 
 # paddle API aliases

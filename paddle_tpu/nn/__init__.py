@@ -1,0 +1,17 @@
+"""paddle.nn surface."""
+from . import functional, initializer
+from .layer import Layer, functional_state
+from .common import *  # noqa: F401,F403
+from .container import LayerDict, LayerList, ParameterList, Sequential
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .transformer import (
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .initializer import ParamAttr

@@ -1,0 +1,105 @@
+"""Gradient clipping strategies.
+
+Reference parity: python/paddle/nn/clip.py — ClipGradByValue,
+ClipGradByNorm (per-tensor), ClipGradByGlobalNorm (the LLM-recipe one).
+Each exposes a pure jax transform over a grads pytree (used by both the
+eager ``optimizer.step`` and the compiled trainer) so sharded/TP params
+get a correct *global* norm: under GSPMD the sum over a sharded pytree
+lowers to the right cross-device reductions automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_value_", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def transform(self, grads_tree):
+        raise NotImplementedError
+
+    def __call__(self, params_and_grads):
+        """paddle signature: list of (param, grad) tensors (eager path)."""
+        from ..tensor import Tensor
+        grads = [g.value if isinstance(g, Tensor) else g
+                 for _, g in params_and_grads]
+        clipped = self.transform(grads)
+        out = []
+        for (p, _), g in zip(params_and_grads, clipped):
+            out.append((p, Tensor(g)))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def transform(self, grads_tree):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads_tree)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def transform(self, grads_tree):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return jax.tree_util.tree_map(clip_one, grads_tree)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2 norm clip across the whole grads pytree — the norm sum is
+    computed in f32; on a sharded mesh XLA inserts the cross-shard
+    reductions (this is where the reference needed an explicit allreduce
+    over hybrid comm groups: fleet grad-clip parity, SURVEY.md §7 hard
+    part #5)."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def transform(self, grads_tree):
+        leaves = jax.tree_util.tree_leaves(grads_tree)
+        if not leaves:
+            return grads_tree
+        gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in leaves)
+        gnorm = jnp.sqrt(gnorm_sq)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+            grads_tree)
+
+    def global_norm(self, grads_tree):
+        leaves = jax.tree_util.tree_leaves(grads_tree)
+        return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in leaves))
+
+
+def clip_grad_value_(parameters, clip_value):
+    clip = ClipGradByValue(clip_value)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = clip.transform([p._grad])[0]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p._grad is not None]
+    clip = ClipGradByGlobalNorm(max_norm)
+    grads = [p._grad for p in params]
+    norm = clip.global_norm(grads)
+    new = clip.transform(grads)
+    for p, g in zip(params, new):
+        p._grad = g
+    from ..tensor import Tensor
+    return Tensor(norm)

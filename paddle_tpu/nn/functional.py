@@ -1,0 +1,67 @@
+"""paddle.nn.functional surface.
+
+Reference parity: python/paddle/nn/functional/* — re-exports the
+tensorized nn ops plus composition helpers.  The fused attention entry
+point dispatches to the Pallas flash-attention kernel on TPU
+(``FLAGS_use_pallas``) and to the jnp oracle elsewhere.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..common.flags import get_flag
+from ..ops.api import (  # noqa: F401
+    adaptive_avg_pool2d, adaptive_max_pool2d, avg_pool2d,
+    binary_cross_entropy, binary_cross_entropy_with_logits, celu,
+    conv1d, conv2d, conv2d_transpose, conv3d, cosine_similarity,
+    cross_entropy, dropout, elu, embedding, gelu, glu, group_norm,
+    gumbel_softmax, hardshrink, hardsigmoid, hardswish, hardtanh,
+    instance_norm, interpolate, kl_div, l1_loss, label_smooth, layer_norm,
+    leaky_relu, linear, log_softmax, logsigmoid, max_pool2d, maxout, mish,
+    mse_loss, nll_loss, normalize, one_hot, pad, pixel_shuffle, prelu,
+    relu, relu6, rms_norm, selu, sigmoid, sigmoid_focal_loss, silu,
+    smooth_l1_loss, softmax, softplus, softshrink, softsign, swish,
+    tanhshrink, thresholded_relu, unfold,
+)
+from ..ops import api as _api
+from ..tensor import apply_op
+from ..runtime.device import is_compiled_with_tpu
+
+batch_norm = _api.batch_norm
+scaled_dot_product_attention_ref = _api.scaled_dot_product_attention
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    """Fused attention entry point (paddle F.scaled_dot_product_attention;
+    phi fused flash_attn kernel analog).  Layout: [B, S, H, D].
+
+    Routes to the Pallas flash kernel when on TPU with no additive mask and
+    no dropout (the fast path used by the LLM recipes); falls back to the
+    jnp reference otherwise.
+    """
+    use_pallas = (
+        get_flag("use_pallas")
+        and attn_mask is None
+        and dropout_p == 0.0
+        and is_compiled_with_tpu()
+    )
+    if use_pallas:
+        from ..ops.pallas.flash_attention import flash_attention_raw
+        try:
+            return apply_op(flash_attention_raw, query, key, value,
+                            causal=is_causal)
+        except Exception:  # pragma: no cover — pallas lowering unavailable
+            pass
+    return _api.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return (out, None) if return_softmax else out

@@ -1,0 +1,202 @@
+"""Weight initializers + ParamAttr.
+
+Reference parity: paddle.nn.initializer (python/paddle/nn/initializer/*) —
+Constant, Normal, TruncatedNormal, Uniform, XavierNormal/Uniform,
+KaimingNormal/Uniform, Assign — and ``paddle.ParamAttr``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import convert_dtype
+from ..ops import random as _random
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "ParamAttr", "calculate_gain", "set_global_initializer",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle linear weight: [in, out]
+        return shape[0], shape[1]
+    # conv: [out_c, in_c/groups, *k]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full([int(s) for s in shape], self.value,
+                        dtype=convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        dt = convert_dtype(dtype)
+        out = jax.random.normal(_random.split_key(), [int(s) for s in shape],
+                                dtype=jnp.float32)
+        return (out * self.std + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        dt = convert_dtype(dtype)
+        out = jax.random.truncated_normal(
+            _random.split_key(), self.a, self.b, [int(s) for s in shape],
+            dtype=jnp.float32)
+        return (out * self.std + self.mean).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        dt = convert_dtype(dtype)
+        out = jax.random.uniform(_random.split_key(), [int(s) for s in shape],
+                                 dtype=jnp.float32, minval=self.low,
+                                 maxval=self.high)
+        return out.astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return Normal(0.0, std)(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(
+            self.value.value if hasattr(self.value, "value") else self.value,
+            dtype=convert_dtype(dtype))
+        assert tuple(arr.shape) == tuple(int(s) for s in shape), \
+            f"Assign initializer shape {arr.shape} != {shape}"
+        return arr
+
+
+class ParamAttr:
+    """paddle.ParamAttr — bundles name/initializer/lr/regularizer/trainable."""
+
+    def __init__(self, name=None, initializer: Optional[Initializer] = None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+_GLOBAL_WEIGHT_INIT: Optional[Initializer] = None
+_GLOBAL_BIAS_INIT: Optional[Initializer] = None
+
+
+def set_global_initializer(weight_init: Optional[Initializer],
+                           bias_init: Optional[Initializer] = None):
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _resolve_initializer(attr, is_bias: bool, default_initializer):
+    """attr may be: None | False | ParamAttr | Initializer."""
+    if attr is False:
+        return None
+    if isinstance(attr, Initializer):
+        return attr
+    if isinstance(attr, ParamAttr) and attr.initializer is not None:
+        return attr.initializer
+    if default_initializer is not None:
+        return default_initializer
+    if is_bias:
+        return _GLOBAL_BIAS_INIT or Constant(0.0)
+    return _GLOBAL_WEIGHT_INIT or XavierNormal()

@@ -1,0 +1,343 @@
+"""nn.Layer — the module system.
+
+Reference parity: paddle's ``nn.Layer`` (python/paddle/nn/layer/layers.py):
+named parameters/buffers/sublayers, forward hooks, ``train``/``eval``
+modes, ``state_dict``/``set_state_dict``, ``create_parameter`` with
+initializer attrs, ``to``/``astype`` casting.
+
+TPU-native addition: :meth:`raw_state_dict` (jax-array pytree) and
+:func:`functional_state` — the bridge that lets the compiled training path
+treat a stateful Layer as a pure function of its parameters (the
+equivalent of the reference's dygraph→static program translation).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common.dtype import convert_dtype, is_floating_point
+from ..common.errors import InvalidArgumentError, enforce
+from ..tensor import Parameter, Tensor
+
+__all__ = ["Layer", "functional_state"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names: set = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._name_scope = name_scope or self.__class__.__name__
+
+    # -- attribute routing ---------------------------------------------------
+    def __setattr__(self, name: str, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            enforce(params is not None,
+                    "call Layer.__init__ before assigning parameters")
+            params[name] = value
+            layers.pop(name, None) if layers else None
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            enforce(layers is not None,
+                    "call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                del params[name]
+            if layers is not None and name in layers and value is None:
+                del layers[name]
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- parameter / buffer management ----------------------------------------
+    def create_parameter(self, shape, dtype=None, attr=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        from .initializer import _resolve_initializer
+        dtype = convert_dtype(dtype or self._dtype)
+        init = _resolve_initializer(attr, is_bias, default_initializer)
+        value = init(shape, dtype)
+        p = Parameter(value, dtype=dtype)
+        if attr is not None and getattr(attr, "name", None):
+            p.name = attr.name
+        if attr is not None and getattr(attr, "trainable", True) is False:
+            p.trainable = False
+            p.stop_gradient = True
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters.pop(name, None)
+            object.__setattr__(self, name, None)
+        else:
+            setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        if tensor is None:
+            self._buffers.pop(name, None)
+            object.__setattr__(self, name, None)
+            return
+        enforce(isinstance(tensor, Tensor), "buffer must be a Tensor")
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+
+    # -- traversal -----------------------------------------------------------
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix,
+                                           include_self=True)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        for name, layer in self.named_sublayers(prefix=prefix,
+                                                include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes ---------------------------------------------------------------
+    def train(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, include_sublayers: bool = True,
+                   structured_name_prefix: str = "",
+                   use_hook: bool = True) -> Dict[str, Tensor]:
+        out: Dict[str, Tensor] = OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, layer in self.named_sublayers(
+                prefix=structured_name_prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                out[f"{name}.{bname}" if name else bname] = b
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            arr = src.value if isinstance(src, Tensor) else np.asarray(src)
+            enforce(tuple(arr.shape) == tuple(target.value.shape),
+                    f"shape mismatch for {name}: {arr.shape} vs "
+                    f"{tuple(target.value.shape)}")
+            target.set_value(arr)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype / device ------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            for pname, p in list(layer._parameters.items()):
+                if p is None:
+                    continue
+                v = p.value
+                if dtype is not None and is_floating_point(v.dtype):
+                    v = v.astype(convert_dtype(dtype))
+                if device is not None:
+                    from ..runtime.device import _parse
+                    v = jax.device_put(v, _parse(str(device)).jax_device)
+                p._value = v
+            for bname, b in list(layer._buffers.items()):
+                if b is None:
+                    continue
+                v = b.value
+                if dtype is not None and is_floating_point(v.dtype):
+                    v = v.astype(convert_dtype(dtype))
+                if device is not None:
+                    from ..runtime.device import _parse
+                    v = jax.device_put(v, _parse(str(device)).jax_device)
+                b._value = v
+        if dtype is not None:
+            self._dtype = convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- misc ----------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            main += "\n" + "\n".join(lines) + "\n"
+        return main + ")"
+
+    # -- functional bridge (compiled path) -----------------------------------
+    def raw_state_dict(self) -> Dict[str, jax.Array]:
+        """Trainable params as a flat {name: jax.Array} pytree."""
+        return {k: p.value for k, p in self.named_parameters()}
+
+    def load_raw_state_dict(self, flat: Dict[str, jax.Array]):
+        params = dict(self.named_parameters())
+        for k, v in flat.items():
+            params[k]._value = v
+
+
+@contextlib.contextmanager
+def functional_state(layer: Layer, params: Dict[str, jax.Array],
+                     buffers: Optional[Dict[str, jax.Array]] = None):
+    """Temporarily bind a param pytree into ``layer`` (torch functional_call
+    analog) so a stateful Layer can be traced as a pure function of
+    ``params`` — the heart of the compiled training path."""
+    named = dict(layer.named_parameters())
+    saved = {k: p._value for k, p in named.items()}
+    named_buf = dict(layer.named_buffers()) if buffers else {}
+    saved_buf = {k: b._value for k, b in named_buf.items()} if buffers else {}
+    try:
+        for k, v in params.items():
+            named[k]._value = v
+        if buffers:
+            for k, v in buffers.items():
+                if k in named_buf:
+                    named_buf[k]._value = v
+        yield layer
+    finally:
+        for k, v in saved.items():
+            named[k]._value = v
+        for k, v in saved_buf.items():
+            named_buf[k]._value = v

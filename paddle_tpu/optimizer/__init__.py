@@ -1,0 +1,2 @@
+from . import lr
+from .optimizer import SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp

@@ -1,0 +1,368 @@
+"""Optimizers: paddle-shaped eager API over a pure functional core.
+
+Reference parity: python/paddle/optimizer/* (SGD, Momentum, Adam, AdamW,
+Adagrad, Adamax, RMSProp, Lamb; ``step``/``clear_grad``/``state_dict``;
+grad_clip; multi_precision).  TPU-native design: each optimizer defines
+``init_slots(param) -> slots`` and ``update(param, grad, slots, lr, step)``
+as pure jax functions, so the SAME math drives (a) the eager ``step()``
+loop and (b) the compiled train step via :meth:`apply_gradients` — a
+jit-able (params, grads, state) -> (params, state) transform.  Optimizer
+state sharding then falls out of GSPMD: state pytrees inherit param
+shardings (the reference needed GroupSharded stage-1 machinery for this).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..common.errors import enforce
+from ..nn.clip import ClipGradBase
+from ..tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adamax", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip: Optional[ClipGradBase] = None, name=None,
+                 multi_precision: bool = True):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = 0.0 if weight_decay is None else (
+            weight_decay if isinstance(weight_decay, float) else
+            getattr(weight_decay, "coeff", 0.0))
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._slots: Dict[int, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- functional core (override in subclasses) ---------------------------
+    def init_slots(self, param: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def update(self, param: jax.Array, grad: jax.Array,
+               slots: Dict[str, jax.Array], lr, step
+               ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def _decoupled_weight_decay(self) -> bool:
+        """AdamW-style decay applied in update(); L2-style handled here."""
+        return False
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        enforce(not isinstance(self._learning_rate, LRScheduler),
+                "cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # -- eager path ----------------------------------------------------------
+    def step(self):
+        params = self._parameter_list
+        enforce(params is not None,
+                "optimizer constructed without a parameter list")
+        lr = self.get_lr()
+        self._step_count += 1
+        with_grad = [p for p in params
+                     if p._grad is not None and p.trainable]
+        if not with_grad:
+            return
+        grads = [p._grad for p in with_grad]
+        if self._grad_clip is not None:
+            grads = self._grad_clip.transform(grads)
+        for p, g in zip(with_grad, grads):
+            if g.dtype != p.value.dtype:
+                g = g.astype(p.value.dtype)
+            if self._weight_decay and not self._decoupled_weight_decay():
+                g = g + self._weight_decay * p.value
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self.init_slots(p.value)
+                self._slots[id(p)] = slots
+            new_p, new_slots = self.update(p.value, g, slots, lr,
+                                           self._step_count)
+            p._value = new_p.astype(p.value.dtype)
+            self._slots[id(p)] = new_slots
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- compiled/functional path -------------------------------------------
+    def init_state(self, params_tree) -> Dict[str, Any]:
+        """Pure: build the optimizer state pytree for a params pytree."""
+        slots = jax.tree_util.tree_map(self.init_slots, params_tree)
+        return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params_tree, grads_tree, state, lr=None):
+        """Pure, jittable: one optimizer step over pytrees."""
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        if self._grad_clip is not None:
+            grads_tree = self._grad_clip.transform(grads_tree)
+
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            if self._weight_decay and not self._decoupled_weight_decay():
+                g = g + self._weight_decay * pf
+            new_p, new_s = self.update(pf, g, s, lr, step)
+            return new_p.astype(p.dtype), new_s
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"slots": jax.tree_util.tree_unflatten(treedef, new_s),
+                 "step": step})
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"@step": self._step_count}
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                slots = self._slots.get(id(p))
+                if slots:
+                    name = p.name or f"param_{i}"
+                    for k, v in slots.items():
+                        out[f"{name}.{k}"] = Tensor(v)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]):
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                name = p.name or f"param_{i}"
+                slots = {}
+                for k, v in state.items():
+                    if isinstance(k, str) and k.startswith(name + "."):
+                        arr = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+                        slots[k[len(name) + 1:]] = arr
+                if slots:
+                    self._slots[id(p)] = slots
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def update(self, param, grad, slots, lr, step):
+        return param - lr * grad, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slots(self, param):
+        return {"velocity": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        v = self._momentum * slots["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(grad)
+        step_f = jnp.asarray(step, jnp.float32)
+        bc1 = 1 - self._beta1 ** step_f
+        bc2 = 1 - self._beta2 ** step_f
+        mhat = m / bc1
+        vhat = v / bc2
+        new_p = param - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (the LLM-recipe optimizer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def update(self, param, grad, slots, lr, step):
+        new_p, new_slots = super().update(param, grad, slots, lr, step)
+        if self._weight_decay:
+            new_p = new_p - lr * self._weight_decay * param
+        return new_p, new_slots
+
+    def step(self):
+        # honor apply_decay_param_fun by zeroing decay per-param (eager path)
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        wd = self._weight_decay
+        try:
+            params = self._parameter_list or []
+            skip = [p for p in params
+                    if not self._apply_decay_param_fun(p.name or "")]
+            saved = [(p, p._value) for p in skip]
+            super().step()
+            # re-add the decay that shouldn't have been applied
+            for p, old in saved:
+                lr = self.get_lr()
+                p._value = p._value + lr * wd * old
+        finally:
+            pass
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc,
+                                        dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        acc = slots["moment"] + jnp.square(grad)
+        new_p = param - lr * grad / (jnp.sqrt(acc) + self._eps)
+        return new_p, {"moment": acc}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, param):
+        return {"moment": jnp.zeros_like(param, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(grad))
+        step_f = jnp.asarray(step, jnp.float32)
+        new_p = param - (lr / (1 - self._beta1 ** step_f)) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.01, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_slots(self, param):
+        slots = {"mean_square": jnp.zeros_like(param, dtype=jnp.float32),
+                 "momentum": jnp.zeros_like(param, dtype=jnp.float32)}
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros_like(param, dtype=jnp.float32)
+        return slots
+
+    def update(self, param, grad, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        out_slots = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+            out_slots["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum"] + lr * grad / denom
+        out_slots["momentum"] = mom
+        return param - mom, out_slots
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def init_slots(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(grad)
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - self._beta1 ** step_f)
+        vhat = v / (1 - self._beta2 ** step_f)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._weight_decay * param
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr * trust * r, {"moment1": m, "moment2": v}

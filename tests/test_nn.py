@@ -1,0 +1,254 @@
+"""nn.Layer system + layer zoo tests (vs numpy/torch-free oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerSystem:
+    def test_parameter_registration(self):
+        lin = nn.Linear(4, 3)
+        names = [n for n, _ in lin.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert lin.weight.shape == [4, 3]
+        assert lin.bias.shape == [3]
+
+    def test_sublayer_traversal(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in model.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(model.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(4, 3)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        assert m.training
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        assert "_mean" in dict(bn.named_buffers())
+        sd = bn.state_dict()
+        assert any("_mean" in k for k in sd)
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_layer_to_dtype(self):
+        lin = nn.Linear(2, 2).bfloat16()
+        assert lin.weight.dtype == paddle.bfloat16
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(4, 3)
+        x = np.random.randn(2, 4).astype(np.float32)
+        out = lin(paddle.to_tensor(x))
+        expected = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.randn(2, 3, 8).astype(np.float32) * 3 + 1
+        out = ln(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = np.random.randn(2, 8).astype(np.float32)
+        out = rn(paddle.to_tensor(x)).numpy()
+        expected = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+        out = gn(paddle.to_tensor(x)).numpy()
+        grouped = x.reshape(2, 2, 2, 5, 5)
+        np.testing.assert_allclose(grouped.mean((2, 3, 4)), out.reshape(
+            2, 2, 2, 5, 5).mean((2, 3, 4)) * 0 + grouped.mean((2, 3, 4)))
+        assert abs(out.reshape(2, 2, -1).mean(-1)).max() < 1e-5
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        x = np.random.randn(2, 3, 16, 16).astype(np.float32)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [2, 8, 16, 16]
+        # compare against explicit correlation for one output position
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        patch = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])[0, :, 4:7, 2:5]
+        expected = (w[1] * patch).sum() + b[1]
+        np.testing.assert_allclose(out.numpy()[0, 1, 4, 2], expected,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        x = paddle.ops.randn([1, 4, 8, 8])
+        assert conv(x).shape == [1, 8, 8, 8]
+
+    def test_conv2d_transpose(self):
+        convt = nn.Conv2DTranspose(4, 3, 2, stride=2)
+        x = paddle.ops.randn([1, 4, 5, 5])
+        assert convt(x).shape == [1, 3, 10, 10]
+
+    def test_pools(self):
+        x = np.random.randn(1, 2, 8, 8).astype(np.float32)
+        mp = nn.MaxPool2D(2)(paddle.to_tensor(x))
+        ap = nn.AvgPool2D(2)(paddle.to_tensor(x))
+        assert mp.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(
+            mp.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].max(), rtol=1e-6)
+        np.testing.assert_allclose(
+            ap.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].mean(), rtol=1e-5)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32) * 2 + 3)
+        out = bn(x)
+        assert abs(out.numpy().mean()) < 1e-5
+        # running stats moved toward batch stats
+        assert abs(bn._mean.numpy().mean() - 0.3) < 0.5
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [16, 4]
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x)
+        frac = (out.numpy() == 0).mean()
+        assert 0.3 < frac < 0.7
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_activations(self):
+        x = np.random.randn(10).astype(np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(nn.ReLU()(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(nn.Sigmoid()(t).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        gelu = nn.GELU()(t).numpy()
+        from scipy.stats import norm as snorm
+        np.testing.assert_allclose(gelu, x * snorm.cdf(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_losses(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+        loss = nn.CrossEntropyLoss()(paddle.to_tensor(logits),
+                                     paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+        pred = np.random.randn(3, 2).astype(np.float32)
+        tgt = np.random.randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            nn.MSELoss()(paddle.to_tensor(pred), paddle.to_tensor(tgt)).numpy(),
+            ((pred - tgt) ** 2).mean(), rtol=1e-6)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+    def test_attention_matches_reference(self):
+        np.random.seed(0)
+        q = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        k = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        v = np.random.randn(2, 6, 4, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True)
+        # numpy oracle
+        qh = np.moveaxis(q, 2, 1)
+        kh = np.moveaxis(k, 2, 1)
+        vh = np.moveaxis(v, 2, 1)
+        logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(8)
+        mask = np.tril(np.ones((6, 6), bool))
+        logits = np.where(mask, logits, -np.inf)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        expected = np.moveaxis(probs @ vh, 1, 2)
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.ops.randn([2, 5, 16])
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.ops.randn([2, 5, 16])
+        assert enc(x).shape == [2, 5, 16]
+        # the two stacked layers must have independent params
+        p = enc.parameters()
+        assert len(p) == 2 * len(layer.parameters())
+
+
+class TestGradFlow:
+    def test_linear_backward(self):
+        lin = nn.Linear(3, 2)
+        x = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+        loss = lin(x).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert lin.bias.grad is not None
+        np.testing.assert_allclose(lin.bias.grad.numpy(), [4.0, 4.0])
+
+    def test_mlp_grads_match_fd(self):
+        model = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 1))
+        x_np = np.random.randn(2, 3).astype(np.float32)
+
+        def loss_at(wval):
+            model[0].weight.set_value(wval)
+            return float(model(paddle.to_tensor(x_np)).sum().numpy())
+
+        w0 = model[0].weight.numpy().copy()
+        loss = model(paddle.to_tensor(x_np)).sum()
+        loss.backward()
+        analytic = model[0].weight.grad.numpy()
+        eps = 1e-3
+        w = w0.copy()
+        w[1, 2] += eps
+        fp = loss_at(w)
+        w[1, 2] -= 2 * eps
+        fm = loss_at(w)
+        np.testing.assert_allclose(analytic[1, 2], (fp - fm) / (2 * eps),
+                                   rtol=1e-2)

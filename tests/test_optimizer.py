@@ -1,0 +1,165 @@
+"""Optimizer + LR scheduler + grad-clip tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def quad_problem():
+    """Minimize ||w - 3||^2 — every optimizer should converge."""
+    w = paddle.Parameter(np.zeros(4, np.float32))
+    target = paddle.to_tensor(np.full(4, 3.0, np.float32))
+    return w, target
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("opt_cls,kwargs,steps,tol", [
+        (optimizer.SGD, dict(learning_rate=0.1), 200, 0.05),
+        (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9), 200, 0.05),
+        (optimizer.Adam, dict(learning_rate=0.1), 300, 0.05),
+        (optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.0), 300, 0.05),
+        (optimizer.Adagrad, dict(learning_rate=0.5), 300, 0.1),
+        (optimizer.RMSProp, dict(learning_rate=0.05), 300, 0.1),
+        (optimizer.Adamax, dict(learning_rate=0.1), 300, 0.1),
+        (optimizer.Lamb, dict(learning_rate=0.03, lamb_weight_decay=0.0), 400, 0.15),
+    ])
+    def test_converges(self, opt_cls, kwargs, steps, tol):
+        w, target = quad_problem()
+        opt = opt_cls(parameters=[w], **kwargs)
+        for _ in range(steps):
+            loss = ((w - target) * (w - target)).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), 3.0, atol=tol)
+
+    def test_sgd_exact_step(self):
+        w = paddle.Parameter(np.array([1.0, 2.0], np.float32))
+        opt = optimizer.SGD(learning_rate=0.5, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.0, 0.0])  # w - 0.5*2w
+
+    def test_adam_against_manual(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2).sum().backward()   # grad = 2
+        opt.step()
+        # manual: m=0.2, v=0.004, mhat=2, vhat=4 → step = 0.1*2/(2+eps)=0.1
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-5)
+
+    def test_adamw_decay(self):
+        w = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                              parameters=[w])
+        (w * 0).sum().backward()   # zero grad → only decay acts
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5 * 1.0],
+                                   rtol=1e-6)
+
+    def test_weight_decay_l2_sgd(self):
+        w = paddle.Parameter(np.array([2.0], np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, weight_decay=0.1,
+                            parameters=[w])
+        (w * 0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [2.0 - 0.1 * 0.1 * 2.0],
+                                   rtol=1e-6)
+
+    def test_state_dict_roundtrip(self):
+        w = paddle.Parameter(np.ones(2, np.float32))
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(
+            opt2._slots[id(w)]["moment1"], opt._slots[id(w)]["moment1"])
+
+    def test_functional_apply_matches_eager(self):
+        w_e = paddle.Parameter(np.array([1.0, -2.0], np.float32))
+        opt_e = optimizer.Adam(learning_rate=0.1, parameters=[w_e])
+        g = np.array([0.5, -1.0], np.float32)
+        w_e._grad = paddle.to_tensor(g).value
+        opt_e.step()
+
+        opt_f = optimizer.Adam(learning_rate=0.1)
+        params = {"w": np.array([1.0, -2.0], np.float32)}
+        state = opt_f.init_state(params)
+        new_params, state = opt_f.apply_gradients(params, {"w": g}, state)
+        np.testing.assert_allclose(w_e.numpy(), np.asarray(new_params["w"]),
+                                   rtol=1e-6)
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        w1 = paddle.Parameter(np.zeros(3, np.float32))
+        w2 = paddle.Parameter(np.zeros(4, np.float32))
+        clip = paddle.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[w1, w2],
+                            grad_clip=clip)
+        g1 = np.full(3, 3.0, np.float32)
+        g2 = np.full(4, 4.0, np.float32)
+        w1._grad = paddle.to_tensor(g1).value
+        w2._grad = paddle.to_tensor(g2).value
+        gnorm = np.sqrt((g1 ** 2).sum() + (g2 ** 2).sum())
+        opt.step()
+        np.testing.assert_allclose(-w1.numpy(), g1 / gnorm, rtol=1e-5)
+        np.testing.assert_allclose(-w2.numpy(), g2 / gnorm, rtol=1e-5)
+
+    def test_clip_noop_when_small(self):
+        w = paddle.Parameter(np.zeros(2, np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[w],
+                            grad_clip=paddle.ClipGradByGlobalNorm(100.0))
+        w._grad = paddle.to_tensor(np.array([0.1, 0.1], np.float32)).value
+        opt.step()
+        np.testing.assert_allclose(-w.numpy(), [0.1, 0.1], rtol=1e-6)
+
+    def test_clip_by_value(self):
+        clip = paddle.ClipGradByValue(0.5)
+        out = clip.transform([np.array([2.0, -3.0, 0.2], np.float32)])
+        np.testing.assert_allclose(out[0], [0.5, -0.5, 0.2])
+
+
+class TestLRSchedulers:
+    def test_scheduler_drives_optimizer(self):
+        from paddle_tpu.optimizer import lr
+        sched = lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.1)
+        w = paddle.Parameter(np.zeros(1, np.float32))
+        opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == 1.0
+        sched.step()
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.1)
+
+    def test_warmup(self):
+        from paddle_tpu.optimizer import lr
+        sched = lr.LinearWarmup(learning_rate=1.0, warmup_steps=10,
+                                start_lr=0.0, end_lr=1.0)
+        vals = []
+        for _ in range(12):
+            vals.append(sched())
+            sched.step()
+        assert vals[0] == 0.0
+        assert vals[5] == pytest.approx(0.5)
+        assert vals[11] == pytest.approx(1.0)
+
+    def test_cosine(self):
+        from paddle_tpu.optimizer import lr
+        sched = lr.CosineAnnealingDecay(learning_rate=2.0, T_max=10)
+        assert sched() == pytest.approx(2.0)
+        sched.step(10)
+        assert sched() == pytest.approx(0.0, abs=1e-6)
+
+    def test_noam(self):
+        from paddle_tpu.optimizer import lr
+        sched = lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        lrs = []
+        for _ in range(200):
+            sched.step()
+            lrs.append(sched())
+        peak = np.argmax(lrs)
+        assert 95 <= peak + 1 <= 105  # peaks at warmup boundary
