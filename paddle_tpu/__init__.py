@@ -25,9 +25,13 @@ from . import nn
 from . import optimizer
 from .nn.initializer import ParamAttr
 from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from . import io
+from . import jit
+from .framework import io as _framework_io
+from .framework.io import load, save
 
-# Subsystem imports land as modules are built (amp, io, jit,
-# distributed, hapi, profiler are appended below once present).
+# Subsystem imports land as modules are built (amp, distributed, hapi,
+# profiler are appended below once present).
 
 # paddle API aliases
 bool = bool_  # noqa: A001

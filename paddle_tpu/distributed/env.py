@@ -1,0 +1,30 @@
+"""Distributed environment facts.
+
+Reference parity: paddle.distributed rank/world-size env (PADDLE_TRAINER_ID
+/ PADDLE_TRAINERS_NUM set by launch).  On TPU: jax process index/count
+(multi-host via jax.distributed) with the PADDLE_* env vars honored for
+launch-tool compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank() -> int:
+    if "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ["PADDLE_TRAINER_ID"])
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def get_world_size() -> int:
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1

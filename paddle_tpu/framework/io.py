@@ -1,0 +1,62 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py — pickle-based state_dict
+persistence (.pdparams/.pdopt).  Tensors are converted to numpy on save
+and restored as Tensors on load; nested dicts/lists/tuples round-trip.
+The sharded/distributed checkpoint path (orbax/tensorstore) lives in
+paddle_tpu.distributed.checkpoint — this is the single-host format.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_saveable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True,
+                "data": np.asarray(obj.value),
+                "stop_gradient": obj.stop_gradient,
+                "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient",
+                                                          True))
+            t.name = obj.get("name")
+            return t
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        return _from_saveable(pickle.load(f))

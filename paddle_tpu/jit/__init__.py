@@ -1,0 +1,2 @@
+from .to_static import InputSpec, StaticFunction, ignore_module, not_to_static, to_static
+from .save_load import load, save

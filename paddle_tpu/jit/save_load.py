@@ -1,0 +1,112 @@
+"""paddle.jit.save / paddle.jit.load.
+
+Reference parity: python/paddle/jit/api.py — exports a traced inference
+program + params (.pdmodel/.pdiparams), reloadable as a TranslatedLayer.
+TPU-native design: the traced program is serialized **StableHLO** via
+``jax.export`` (the XLA-native interchange format — the analog of the
+reference's ProgramDesc protobuf), params via the framework saver.
+``load`` returns a callable TranslatedLayer running the deserialized
+StableHLO, usable from pure Python without the original model code.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import enforce
+from ..framework import io as fio
+from ..nn.layer import Layer, functional_state
+from ..tensor import Tensor, to_tensor
+from .to_static import InputSpec, StaticFunction
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """Serialize ``layer`` (or a StaticFunction) for inference.
+
+    Produces ``{path}.pdmodel`` (StableHLO + metadata) and
+    ``{path}.pdiparams`` (weights).
+    """
+    enforce(isinstance(layer, Layer), "jit.save expects a Layer")
+    enforce(input_spec is not None and len(input_spec) > 0,
+            "jit.save requires input_spec (static shapes)")
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            raise TypeError(f"bad input_spec entry {s!r}")
+
+    layer.eval()
+    params = layer.raw_state_dict()
+    buffers = {k: b.value for k, b in layer.named_buffers()}
+    fn = layer.forward
+    if isinstance(fn, StaticFunction):
+        fn = fn.function
+
+    def pure(param_vals, buffer_vals, *args):
+        tensors = [Tensor(a, stop_gradient=True) for a in args]
+        with functional_state(layer, param_vals, buffer_vals):
+            out = fn(*tensors)
+        flat, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        return tuple(o.value if isinstance(o, Tensor) else o for o in flat)
+
+    arg_shapes = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+                  for s in specs]
+    param_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    buffer_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers)
+
+    exported = jax.export.export(jax.jit(pure))(
+        param_shapes, buffer_shapes, *arg_shapes)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"stablehlo": blob,
+                     "input_specs": [(s.shape, s.dtype.name) for s in specs]},
+                    f)
+    fio.save({"params": {k: Tensor(v) for k, v in params.items()},
+              "buffers": {k: Tensor(v) for k, v in buffers.items()}},
+             path + ".pdiparams")
+
+
+class TranslatedLayer(Layer):
+    """Inference-only layer reconstituted from serialized StableHLO."""
+
+    def __init__(self, exported, params, buffers, input_specs):
+        super().__init__()
+        self._exported = exported
+        self._params = params
+        self._buffers_vals = buffers
+        self._input_specs = input_specs
+        self.eval()
+
+    def forward(self, *args):
+        arrs = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(self._params, self._buffers_vals, *arrs)
+        wrapped = [Tensor(o) for o in out]
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    exported = jax.export.deserialize(meta["stablehlo"])
+    state = fio.load(path + ".pdiparams")
+    params = {k: v.value for k, v in state["params"].items()}
+    buffers = {k: v.value for k, v in state["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers, meta["input_specs"])

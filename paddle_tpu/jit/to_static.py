@@ -1,0 +1,184 @@
+"""to_static: the dygraph → compiled-program boundary.
+
+Reference parity: ``paddle.jit.to_static`` (python/paddle/jit/ — the AST/
+SOT bytecode tracers that build a static program, compiled by CINN).
+TPU-native design: the "static program" IS an XLA computation traced by
+``jax.jit`` — our eager Tensors wrap tracers transparently, so the user's
+dygraph code traces as-is (jax tracing == SOT's symbolic tracing with the
+same no-data-dependent-control-flow contract; CINN's fusion role is
+played by XLA).
+
+The returned StaticFunction:
+  * caches compiled executables per (tree-structure, shapes, dtypes,
+    static-args, training-mode) signature — mirroring SOT's guard cache;
+  * threads the owning Layer's parameters/buffers as traced inputs, so
+    param updates between calls do NOT trigger recompiles;
+  * is differentiable: calling it under the eager tape records ONE
+    GradNode whose vjp is the XLA-differentiated whole program, with
+    grads flowing into the Layer's Parameters.
+
+Known functional-purity caveat (documented parity gap): BatchNorm
+running-stat mutation inside a to_static region is reverted at trace
+exit; use the eager path or the hapi trainer for BN-stat updates.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtype import convert_dtype
+from ..common.errors import enforce
+from ..nn.layer import Layer, functional_state
+from ..ops import random as _random
+from ..tensor import Tensor, apply_op
+
+__all__ = ["InputSpec", "to_static", "not_to_static", "ignore_module",
+           "StaticFunction"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec — static-shape signature declaration."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor: Tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
+class StaticFunction:
+    def __init__(self, function: Callable, input_spec=None,
+                 build_strategy=None, backend=None, full_graph=True,
+                 layer: Optional[Layer] = None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        functools.update_wrapper(self, function)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunction(
+            self._function.__get__(instance, owner), self._input_spec,
+            layer=instance if isinstance(instance, Layer) else None)
+
+    def __call__(self, *args, **kwargs):
+        enforce(not any(_is_tensor_leaf(v) for v in kwargs.values()),
+                "to_static: pass Tensor arguments positionally")
+        layer = self._layer
+        flat_args, arg_treedef = jax.tree_util.tree_flatten(
+            list(args), is_leaf=lambda x: isinstance(x, Tensor))
+        arrays = [a.value if isinstance(a, Tensor) else a for a in flat_args]
+        tensor_idx = [i for i, a in enumerate(flat_args) if _is_tensor_leaf(a)]
+        static_leaves = tuple((i, flat_args[i]) for i in range(len(flat_args))
+                              if i not in tensor_idx)
+
+        named = dict(layer.named_parameters()) if layer is not None else {}
+        param_names = list(named.keys())
+        buffer_vals = {k: b.value for k, b in layer.named_buffers()} \
+            if layer is not None else {}
+        training = layer.training if layer is not None else True
+
+        key = (arg_treedef,
+               tuple((jnp.shape(arrays[i]), str(jnp.result_type(arrays[i])))
+                     for i in tensor_idx),
+               tuple(sorted(kwargs.items())),
+               static_leaves, tuple(param_names), training)
+        try:
+            hash(key)
+        except TypeError:
+            key = None
+
+        entry = self._cache.get(key) if key is not None else None
+        if entry is None:
+            fn = self._function
+            out_tree_box = {}
+
+            def jittable(param_vals: dict, buf_vals: dict, rng_key,
+                         tensor_arrays: list):
+                full = list(flat_args)
+                for j, i in enumerate(tensor_idx):
+                    full[i] = Tensor(tensor_arrays[j], stop_gradient=True)
+                call_args = jax.tree_util.tree_unflatten(arg_treedef, full)
+
+                def run():
+                    with _random.rng_guard(rng_key):
+                        return fn(*call_args, **kwargs)
+                if layer is not None:
+                    with functional_state(layer, param_vals, buf_vals):
+                        out = run()
+                else:
+                    out = run()
+                flat_out, out_tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_tree_box["tree"] = out_tree
+                return tuple(o.value if isinstance(o, Tensor) else o
+                             for o in flat_out)
+
+            jitted = jax.jit(jittable)
+            entry = (jitted, out_tree_box)
+            if key is not None:
+                self._cache[key] = entry
+        jitted, out_tree_box = entry
+
+        rng_key = _random.split_key()
+        params_list = [named[n] for n in param_names]
+
+        def raw(param_list, tensor_arrays_list):
+            return jitted(dict(zip(param_names, param_list)), buffer_vals,
+                          rng_key, tensor_arrays_list)
+        raw.__name__ = getattr(self._function, "__name__", "static_fn")
+
+        tensor_arrays = [flat_args[i] for i in tensor_idx]
+        out = apply_op(raw, params_list, tensor_arrays)
+        flat_out = list(out) if isinstance(out, (tuple, list)) else [out]
+        return jax.tree_util.tree_unflatten(out_tree_box["tree"], flat_out)
+
+    @property
+    def function(self):
+        return self._function
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper: ``paddle.jit.to_static`` analog.  ``backend`` is
+    accepted for parity (CINN in the reference); XLA is always the
+    compiler here."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, build_strategy,
+                                backend, full_graph, layer=fn)
+            object.__setattr__(fn, "forward", sf)
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn.__not_to_static__ = True
+    return fn
+
+
+def ignore_module(modules: Sequence):
+    return None

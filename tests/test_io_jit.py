@@ -1,0 +1,165 @@
+"""io (DataLoader, save/load) + jit (to_static, jit.save/load) tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i, i * 2]), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 2]
+        assert y.shape == [4]
+        np.testing.assert_allclose(x.numpy()[:, 0], [0, 1, 2, 3])
+
+    def test_drop_last_and_shuffle(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4, shuffle=True,
+                        drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 2
+        seen = np.concatenate([b[0].numpy()[:, 0] for b in batches])
+        assert len(set(seen.tolist())) == 8
+
+    def test_prefetch_worker(self):
+        dl = DataLoader(RangeDataset(8), batch_size=2, num_workers=2)
+        assert len(list(dl)) == 4
+
+    def test_tensor_dataset(self):
+        xs = np.random.randn(6, 3).astype(np.float32)
+        ys = np.arange(6)
+        ds = TensorDataset([xs, ys])
+        x, y = ds[2]
+        np.testing.assert_allclose(x.numpy(), xs[2])
+
+    def test_distributed_sampler_shards(self):
+        ds = RangeDataset(12)
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=3, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=3, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 4
+        assert not set(i0) & set(i1)
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        p = str(tmp_path / "model.pdparams")
+        paddle.save(model.state_dict(), p)
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model2.set_state_dict(paddle.load(p))
+        x = paddle.ops.randn([2, 4])
+        np.testing.assert_allclose(model(x).numpy(), model2(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        w = paddle.Parameter(np.ones(3, np.float32))
+        opt = paddle.optimizer.Adam(parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        p = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), p)
+        loaded = paddle.load(p)
+        assert loaded["@step"] == 1
+
+
+class TestToStatic:
+    def test_function_traces_and_caches(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x, y):
+            calls.append(1)
+            return x * y + 1
+
+        a = paddle.ops.randn([3])
+        b = paddle.ops.randn([3])
+        out1 = f(a, b)
+        out2 = f(b, a)
+        np.testing.assert_allclose(out1.numpy(), a.numpy() * b.numpy() + 1,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out2.numpy(), out1.numpy(), rtol=1e-6)
+        assert len(calls) == 1  # second call hit the jit cache
+
+    def test_recompiles_on_new_shape(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x.sum()
+
+        f(paddle.ops.randn([3]))
+        f(paddle.ops.randn([3]))
+        f(paddle.ops.randn([5]))
+        assert len(calls) == 2
+
+    def test_layer_to_static_grads(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        model_ts = paddle.jit.to_static(model)
+        x = paddle.ops.randn([2, 4])
+        loss = model_ts(x).sum()
+        loss.backward()
+        g_static = model[0].weight.grad.numpy().copy()
+        # eager reference
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        model2.set_state_dict(model.state_dict())
+        loss2 = model2(x).sum()
+        loss2.backward()
+        np.testing.assert_allclose(g_static, model2[0].weight.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_param_update_no_stale_cache(self):
+        lin = nn.Linear(2, 1, bias_attr=False)
+        lin_ts = paddle.jit.to_static(lin)
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        out1 = float(lin_ts(x).numpy())
+        lin.weight.set_value(lin.weight.numpy() * 0)
+        out2 = float(lin_ts(x).numpy())
+        assert out2 == pytest.approx(0.0)
+        assert out1 != 0.0 or abs(out1) < 1e-9
+
+    def test_training_eval_mode_cached_separately(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        model_ts = paddle.jit.to_static(model)
+        x = paddle.ones([8, 4])
+        model.train()
+        out_train = model_ts(x).numpy()
+        model.eval()
+        out_eval = model_ts(x).numpy()
+        assert (out_eval == 0).mean() < 0.01  # no dropout in eval
+        assert (out_train == 0).mean() > 0.1  # dropout active in train
+
+
+class TestJitSaveLoad:
+    def test_save_load_inference(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 3))
+        model.eval()
+        path = str(tmp_path / "infer/model")
+        paddle.jit.save(model, path,
+                        input_spec=[paddle.jit.InputSpec([2, 4], "float32")])
+        assert os.path.exists(path + ".pdmodel")
+        assert os.path.exists(path + ".pdiparams")
+        loaded = paddle.jit.load(path)
+        x = paddle.ops.randn([2, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
