@@ -25,8 +25,10 @@ from . import nn
 from . import optimizer
 from .nn.initializer import ParamAttr
 from .nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from . import amp
 from . import io
 from . import jit
+from . import models
 from .framework import io as _framework_io
 from .framework.io import load, save
 

@@ -1,0 +1,93 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/auto_cast.py — ``auto_cast`` context
+(O1: per-op white/black lists; O2: model-wide low precision via
+``decorate``), bf16/fp16 support.
+
+TPU-native design: bf16 is the native MXU dtype, so O2-style "params and
+compute in bf16, norms/softmax/losses in f32" is the performant scheme —
+our norm/softmax/loss raw ops already compute statistics in f32
+internally (ops/_nn.py), which supersedes the reference's black-list
+mechanics under XLA.  O1 is still honored eagerly: inside ``auto_cast``
+the white-listed ops (matmul/conv family) cast their float inputs to the
+amp dtype at dispatch (hooked in tensor.apply_op).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+from ..common.dtype import convert_dtype
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "white_list", "black_list",
+           "amp_state"]
+
+# ops whose inputs are cast down in O1 (matmul/conv compute on MXU)
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "scaled_dot_product_attention",
+    "flash_attention_raw",
+}
+# ops forced to run in f32 (numerically sensitive)
+BLACK_LIST: Set[str] = {
+    "log", "log2", "log10", "log1p", "exp", "expm1", "pow", "square",
+    "cross_entropy", "nll_loss", "binary_cross_entropy", "softmax_",
+    "logsumexp", "norm", "mean_", "cumsum",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+_state = threading.local()
+
+
+def amp_state():
+    return getattr(_state, "ctx", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """``paddle.amp.auto_cast`` context manager."""
+    if not enable:
+        yield
+        return
+    ctx = {
+        "level": level,
+        "dtype": convert_dtype(dtype),
+        "white": WHITE_LIST | set(custom_white_list or ()),
+        "black": BLACK_LIST | set(custom_black_list or ()),
+    }
+    prev = getattr(_state, "ctx", None)
+    _state.ctx = ctx
+    try:
+        yield
+    finally:
+        _state.ctx = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the amp dtype (norm params stay f32 via
+    their layers' internal f32 statistics).  Optimizer slots are f32 by
+    construction (master weights — optimizer.py keeps moments in f32 and
+    the reference's multi_precision flag is always-on behavior here)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,123 @@
+"""Compiled training step — the performance path.
+
+Reference parity: this is where the reference's dygraph-to-static +
+CINN-compiled training program lands (SURVEY.md §3.3/§3.5): ONE XLA
+computation per step containing fwd, bwd, grad-clip, optimizer update —
+no per-op python dispatch, no tape.  The eager path (loss.backward();
+opt.step()) stays available for debugging; this class is what recipes and
+benchmarks use.
+
+Sharded training: pass ``mesh`` + ``param_sharding_fn`` (see
+distributed/) and every state leaf gets a NamedSharding; XLA's SPMD
+partitioner then inserts the collectives (GSPMD — the fleet replacement).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..nn.layer import Layer, functional_state
+from ..ops import random as _random
+from ..optimizer.optimizer import Optimizer
+from ..tensor import Tensor
+
+__all__ = ["CompiledTrainStep"]
+
+
+def _to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, Tensor) else jnp.asarray(x), tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class CompiledTrainStep:
+    """Owns (params, opt_state) as jax pytrees; one call = one fused step.
+
+    loss_fn(model, batch) -> scalar loss Tensor, where ``batch`` is the
+    user's pytree with leaves delivered as Tensors.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
+                 seed: int = 0, donate: bool = True,
+                 out_shardings=None, state_sharding_fn=None,
+                 extra_metrics_fn: Optional[Callable] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        params = model.raw_state_dict()
+        self.state: Dict[str, Any] = {
+            "params": params,
+            "opt": optimizer.init_state(params),
+        }
+        if state_sharding_fn is not None:
+            self.state = state_sharding_fn(self.state)
+        self._key = jax.random.key(seed)
+        self._step_fn = None
+        self._donate = donate
+
+    def _build(self):
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+
+        def step(state, batch, key, lr):
+            def pure_loss(p):
+                batch_t = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), batch)
+                with tape.no_grad():
+                    with functional_state(model, p):
+                        with _random.rng_guard(key):
+                            out = loss_fn(model, batch_t)
+                return out.value if isinstance(out, Tensor) else out
+
+            loss, grads = jax.value_and_grad(pure_loss)(state["params"])
+            new_params, new_opt = optimizer.apply_gradients(
+                state["params"], grads, state["opt"], lr=lr)
+            return {"params": new_params, "opt": new_opt}, loss
+
+        self._step_fn = jax.jit(
+            step, donate_argnums=(0,) if self._donate else ())
+
+    def __call__(self, batch) -> jax.Array:
+        if self._step_fn is None:
+            self._build()
+        self._key, sub = jax.random.split(self._key)
+        lr = self.optimizer.get_lr()
+        self.state, loss = self._step_fn(self.state, _to_arrays(batch), sub,
+                                         lr)
+        sched = self.optimizer._lr_scheduler
+        if sched is not None:
+            sched.step()
+        return loss
+
+    def eval_step(self, eval_fn: Callable, batch):
+        """Compile-once eval step (no grad, no state mutation)."""
+        if not hasattr(self, "_eval_fns"):
+            self._eval_fns = {}
+        fn = self._eval_fns.get(id(eval_fn))
+        if fn is None:
+            model = self.model
+
+            def run(params, batch, key):
+                batch_t = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), batch)
+                with tape.no_grad(), functional_state(model, params), \
+                        _random.rng_guard(key):
+                    out = eval_fn(model, batch_t)
+                return jax.tree_util.tree_map(
+                    lambda x: x.value if isinstance(x, Tensor) else x, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+            fn = jax.jit(run)
+            self._eval_fns[id(eval_fn)] = fn
+        self._key, sub = jax.random.split(self._key)
+        return fn(self.state["params"], _to_arrays(batch), sub)
+
+    # -- state sync with the eager model ------------------------------------
+    def sync_to_model(self):
+        """Write compiled-state params back into the Layer (for eager use,
+        state_dict saving, etc.)."""
+        self.model.load_raw_state_dict(self.state["params"])
+
+    def sync_from_model(self):
+        self.state["params"] = self.model.raw_state_dict()

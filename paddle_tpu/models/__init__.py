@@ -1,0 +1,2 @@
+from . import gpt
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion

@@ -1,0 +1,207 @@
+"""GPT-2 model family (config #1 of BASELINE.json: GPT-2 124M).
+
+Reference parity: PaddleNLP's GPT implementation
+(examples/language_model/gpt — referenced by BASELINE.json configs), the
+canonical pre-LN GPT-2 architecture: learned positional embeddings,
+attention with causal mask, GELU MLP, tied LM head.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import ops as P
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion", "gpt2_124m_config", "gpt2_tiny_config"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304  # padded to %64 for MXU-friendly lm-head matmul
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+
+def gpt2_124m_config() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt2_tiny_config() -> GPTConfig:
+    return GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=128, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        proj_init = Normal(0.0, c.initializer_range /
+                           math.sqrt(2 * c.num_hidden_layers))
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.qkv_proj = Linear(c.hidden_size, 3 * c.hidden_size,
+                               weight_attr=init)
+        self.out_proj = Linear(c.hidden_size, c.hidden_size,
+                               weight_attr=proj_init)
+        self.dropout_p = c.attention_probs_dropout_prob
+
+    def forward(self, x, cache=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = P.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = P.unstack(qkv, axis=2)
+        if cache is not None:
+            k = P.concat([cache[0], k], axis=1)
+            v = P.concat([cache[1], v], axis=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout_p if self.training else 0.0,
+            is_causal=True, training=self.training)
+        out = P.reshape(out, [b, s, h])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        proj_init = Normal(0.0, c.initializer_range /
+                           math.sqrt(2 * c.num_hidden_layers))
+        self.fc_in = Linear(c.hidden_size, c.intermediate_size,
+                            weight_attr=init)
+        self.fc_out = Linear(c.intermediate_size, c.hidden_size,
+                             weight_attr=proj_init)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            attn_out, new_cache = self.attn(self.ln_1(x), cache)
+            x = x + self.dropout(attn_out)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return x, new_cache
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=init)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size, weight_attr=init)
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        b, s = input_ids.shape
+        past_len = 0 if caches is None else (
+            caches[0][0].shape[1] if caches[0] is not None else 0)
+        if position_ids is None:
+            position_ids = P.arange(past_len, past_len + s, dtype="int32")
+            position_ids = P.unsqueeze(position_ids, 0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False,
+                                  weight_attr=Normal(0.0,
+                                                     config.initializer_range))
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        out = self.gpt(input_ids, position_ids, caches)
+        hidden = out[0] if caches is not None else out
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = P.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        if caches is not None:
+            return logits, out[1]
+        return logits
+
+    def gen_caches(self, batch_size):
+        c = self.config
+        return [(P.zeros([batch_size, 0, c.num_attention_heads,
+                          c.hidden_size // c.num_attention_heads]),
+                 P.zeros([batch_size, 0, c.num_attention_heads,
+                          c.hidden_size // c.num_attention_heads]))
+                for _ in range(c.num_hidden_layers)]
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shifted next-token cross entropy (PaddleNLP criterion analog)."""
+
+    def __init__(self, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        # logits [B,S,V], labels [B,S]: predict labels[t] from logits[t]
+        return F.cross_entropy(
+            P.reshape(logits, [-1, logits.shape[-1]]),
+            P.reshape(labels, [-1]),
+            ignore_index=self.ignore_index)

@@ -322,6 +322,19 @@ def apply_op(raw_fn, *args, **kwargs):
     arrays = [x.value if isinstance(x, Tensor) else jnp.asarray(x)
               for x in leaves]
 
+    # AMP O1: cast inputs of white-listed ops down / black-listed up
+    from .amp.auto_cast import amp_state
+    _amp = amp_state()
+    if _amp is not None:
+        opname = getattr(raw_fn, "__name__", "")
+        if opname in _amp["white"]:
+            arrays = [a.astype(_amp["dtype"])
+                      if a.dtype == jnp.float32 else a for a in arrays]
+        elif opname in _amp["black"]:
+            arrays = [a.astype(jnp.float32)
+                      if a.dtype in (jnp.bfloat16, jnp.float16) else a
+                      for a in arrays]
+
     def rebuild(arrs):
         it = iter(arrs)
         out = []
