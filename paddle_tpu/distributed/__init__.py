@@ -1,1 +1,56 @@
+"""paddle.distributed surface: fleet, collectives, auto-parallel, sharding."""
 from . import env
+from . import auto_parallel
+from . import collective
+from . import fleet as _fleet_mod
+from . import parallel_layers
+from . import sharding
+from . import strategy
+from . import topology
+from .auto_parallel import (
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    get_mesh,
+    reshard,
+    set_mesh,
+    shard_layer,
+    shard_tensor,
+)
+from .collective import (
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+)
+from .env import get_rank, get_world_size
+from .fleet import fleet
+from .strategy import DistributedStrategy
+from .topology import CommGroup, HybridCommunicateGroup, build_mesh
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env — pure-DP default init."""
+    return fleet.init()
+
+
+def is_initialized() -> bool:
+    from .fleet import get_hybrid_communicate_group
+    return get_hybrid_communicate_group() is not None
+
+
+def get_backend() -> str:
+    return "xla"  # ICI/DCN collectives via XLA (reference: nccl)

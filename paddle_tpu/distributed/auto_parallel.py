@@ -1,0 +1,191 @@
+"""Auto-parallel (semi-auto) API: shard_tensor / placements / reshard.
+
+Reference parity: python/paddle/distributed/auto_parallel/ (api.py —
+``shard_tensor(t, mesh, [Shard(0), Replicate()])`` building DistTensor
+with TensorDistAttr) + phi/core/distributed/auto_parallel reshard
+functions + phi/infermeta/spmd_rules (per-op sharding propagation).
+
+TPU-native design: this IS GSPMD (SURVEY.md §2.3) — placements map
+1:1 onto jax.sharding.PartitionSpec / NamedSharding; the reference's
+hand-written per-op SPMD rules and reshard transfer functions collapse
+into XLA's sharding propagation pass; ``reshard`` is a device_put /
+with_sharding_constraint.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..common.errors import enforce
+from ..tensor import Tensor
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "dtensor_from_fn", "shard_layer", "get_mesh",
+           "set_mesh", "placements_to_spec"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  GSPMD materializes partial sums only
+    transiently inside the partitioner; a user-held Partial tensor is
+    reduced eagerly on creation (documented semantic difference)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh — here a named wrapper over
+    jax.sharding.Mesh."""
+
+    def __init__(self, mesh=None, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+            self.dim_names = list(mesh.axis_names)
+        else:
+            arr = np.asarray(mesh if mesh is not None else
+                             range(len(jax.devices())))
+            devices = np.asarray(jax.devices())[arr.reshape(-1)]
+            self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+            self._mesh = Mesh(devices.reshape(arr.shape),
+                              tuple(self.dim_names))
+        self.shape = list(self._mesh.devices.shape)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._mesh.devices.reshape(-1)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self.dim_names})"
+
+
+_GLOBAL_MESH: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: Union[ProcessMesh, Mesh]):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh if isinstance(mesh, ProcessMesh) else ProcessMesh(mesh)
+    return _GLOBAL_MESH
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: Mesh,
+                       ndim: int) -> PartitionSpec:
+    """[Shard(0), Replicate()] on mesh axes (a, b) → PartitionSpec per
+    TENSOR dim: placements are per-MESH-dim (paddle convention)."""
+    entries: List[Optional[object]] = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        axis_name = mesh.axis_names[mesh_dim]
+        if isinstance(placement, Shard):
+            d = placement.dim
+            enforce(0 <= d < ndim, f"Shard dim {d} out of range for ndim {ndim}")
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, mesh: Union[ProcessMesh, Mesh],
+                 placements: Sequence[Placement],
+                 dtype=None, stop_gradient: Optional[bool] = None) -> Tensor:
+    """Place ``x`` on the mesh with the given per-mesh-dim placements.
+    Returns a Tensor whose .value is a globally-sharded jax.Array."""
+    m = mesh.mesh if isinstance(mesh, ProcessMesh) else mesh
+    t = x if isinstance(x, Tensor) else Tensor(x, dtype=dtype)
+    spec = placements_to_spec(placements, m, t.ndim)
+    sharding = NamedSharding(m, spec)
+    arr = jax.device_put(t.value, sharding)
+    # user-held Partial: reduce eagerly (see Partial docstring)
+    for p in placements:
+        if isinstance(p, Partial):
+            raise NotImplementedError(
+                "Partial placements are internal to the partitioner on TPU; "
+                "reduce before sharding")
+    out = Tensor(arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.name = t.name
+    if hasattr(t, "trainable"):  # keep Parameter-ness
+        out._stop_gradient = t._stop_gradient
+    return out
+
+
+def reshard(x: Tensor, mesh: Union[ProcessMesh, Mesh],
+            placements: Sequence[Placement]) -> Tensor:
+    """Reshard a (possibly already sharded) tensor — the reference's
+    ReshardFunction family (s→r, r→s, cross-mesh) collapses into one
+    device_put with the target NamedSharding."""
+    return shard_tensor(x, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """paddle.distributed.shard_layer: apply shard_fn(name, layer, mesh)
+    over sublayers to place parameters."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):  # replicate by default
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    placements = [Replicate()] * len(mesh.shape)
+                    sublayer._parameters[pname] = _shard_param(p, mesh,
+                                                               placements)
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def _shard_param(p, mesh, placements):
+    from ..tensor import Parameter
+    m = mesh.mesh if isinstance(mesh, ProcessMesh) else mesh
+    spec = placements_to_spec(placements, m, p.ndim)
+    arr = jax.device_put(p.value, NamedSharding(m, spec))
+    new = Parameter.__new__(Parameter)
+    Tensor.__init__(new, arr, stop_gradient=p.stop_gradient)
+    new.trainable = getattr(p, "trainable", True)
+    new.optimize_attr = getattr(p, "optimize_attr", {"learning_rate": 1.0})
+    new.regularizer = None
+    new.is_distributed = True
+    new.name = p.name
+    return new

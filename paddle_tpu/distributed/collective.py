@@ -1,0 +1,241 @@
+"""Collective communication API.
+
+Reference parity: paddle.distributed.communication (all_reduce/all_gather/
+reduce_scatter/all_to_all/broadcast/send/recv + ReduceOp + new_group) over
+the C++ ProcessGroup/NCCL stack (SURVEY.md §2.4).
+
+TPU-native design: two layers —
+  1. **In-mesh primitives** (the hot path): thin wrappers over
+     ``jax.lax.psum / all_gather / psum_scatter / all_to_all / ppermute``
+     taking a CommGroup/axis-name; usable inside ``shard_map`` regions.
+     These are what PP schedules and ring attention use — XLA lowers them
+     to ICI collectives.
+  2. **Eager module functions** with paddle signatures.  Under a tracer
+     they dispatch to (1).  On concrete global arrays the single-
+     controller model means the tensor is already global: all_reduce is
+     the identity on replicated values, all_gather/reduce_scatter/
+     broadcast become resharding ops.  (The reference's per-process view
+     does not exist under SPMD — documented mapping, SURVEY.md §2.4.)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..common.errors import enforce
+from ..tensor import Tensor, apply_op
+from .topology import CommGroup
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
+           "all_to_all", "broadcast", "scatter", "reduce", "barrier",
+           "new_group", "get_group", "send", "recv", "psum", "pmean",
+           "pmax", "ppermute", "axis_index", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_GROUPS = {}
+_DEFAULT_GROUP: Optional[CommGroup] = None
+
+
+def _default_group() -> CommGroup:
+    global _DEFAULT_GROUP
+    if _DEFAULT_GROUP is None:
+        from . import fleet
+        hcg = fleet.get_hybrid_communicate_group()
+        enforce(hcg is not None,
+                "call paddle.distributed.fleet.init() (or init_parallel_env) "
+                "before collectives")
+        _DEFAULT_GROUP = hcg.get_data_parallel_group()
+    return _DEFAULT_GROUP
+
+
+def _set_default_group(g: CommGroup):
+    global _DEFAULT_GROUP
+    _DEFAULT_GROUP = g
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None,
+              axis: Optional[Union[str, Sequence[str]]] = None) -> CommGroup:
+    """paddle.distributed.new_group.  On the mesh model a group is a mesh
+    axis (pass ``axis=``); explicit rank lists are accepted only for the
+    trivial all-ranks case."""
+    from . import fleet
+    hcg = fleet.get_hybrid_communicate_group()
+    enforce(hcg is not None, "fleet.init() first")
+    if axis is not None:
+        g = CommGroup(hcg.mesh, tuple([axis] if isinstance(axis, str)
+                                      else axis))
+    else:
+        g = hcg.get_check_parallel_group()
+    _GROUPS[id(g)] = g
+    return g
+
+
+def get_group(gid=None) -> CommGroup:
+    return _default_group()
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(t):
+    return t.value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: in-mesh primitives (shard_map bodies, Pallas loops)
+# ---------------------------------------------------------------------------
+
+def psum(x, group: Union[CommGroup, str]):
+    axis = group.axis_name if isinstance(group, CommGroup) else group
+    return lax.psum(x, axis)
+
+
+def pmean(x, group: Union[CommGroup, str]):
+    axis = group.axis_name if isinstance(group, CommGroup) else group
+    return lax.pmean(x, axis)
+
+
+def pmax(x, group: Union[CommGroup, str]):
+    axis = group.axis_name if isinstance(group, CommGroup) else group
+    return lax.pmax(x, axis)
+
+
+def ppermute(x, group: Union[CommGroup, str], perm):
+    axis = group.axis_name if isinstance(group, CommGroup) else group
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(group: Union[CommGroup, str]):
+    axis = group.axis_name if isinstance(group, CommGroup) else group
+    return lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: paddle-shaped eager API
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
+               sync_op: bool = True):
+    group = group or _default_group()
+    val = _unwrap(tensor)
+    if _is_traced(val):
+        fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+               ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}
+        out = fns[op](val, group.axis_name)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    # concrete global array: already globally reduced under SPMD
+    return tensor
+
+
+def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
+               sync_op: bool = True):
+    """Both signatures supported: paddle's
+    ``all_gather(tensor_list, tensor)`` and functional
+    ``out = all_gather(tensor)``."""
+    group = group or _default_group()
+    if isinstance(tensor_or_list, list) and tensor is not None:
+        val = _unwrap(tensor)
+        if _is_traced(val):
+            out = lax.all_gather(val, group.axis_name)
+            n = group.nranks
+            tensor_or_list.extend(Tensor(out[i]) for i in range(n))
+            return
+        tensor_or_list.extend(Tensor(val) for _ in range(group.nranks))
+        return
+    val = _unwrap(tensor_or_list)
+    if _is_traced(val):
+        out = lax.all_gather(val, group.axis_name, tiled=True)
+        return Tensor(out) if isinstance(tensor_or_list, Tensor) else out
+    return tensor_or_list
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
+                   sync_op: bool = True):
+    group = group or _default_group()
+    val = _unwrap(tensor)
+    if _is_traced(val):
+        out = lax.psum_scatter(val, group.axis_name, tiled=True)
+        return Tensor(out) if isinstance(tensor, Tensor) else out
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None,
+               group: Optional[CommGroup] = None, sync_op: bool = True):
+    """Paddle list signature and functional array signature."""
+    group = group or _default_group()
+    if in_tensor_list is None:
+        # functional: single stacked array, alltoall over leading dim
+        val = _unwrap(out_tensor_list)
+        if _is_traced(val):
+            out = lax.all_to_all(val, group.axis_name, split_axis=0,
+                                 concat_axis=0, tiled=True)
+            return Tensor(out) if isinstance(out_tensor_list, Tensor) else out
+        return out_tensor_list
+    vals = [_unwrap(t) for t in in_tensor_list]
+    if vals and _is_traced(vals[0]):
+        stacked = jnp.stack(vals)
+        out = lax.all_to_all(stacked, group.axis_name, split_axis=0,
+                             concat_axis=0)
+        out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+        return
+    out_tensor_list.extend(Tensor(v) for v in vals)
+
+
+alltoall = all_to_all
+
+
+def broadcast(tensor, src: int = 0, group: Optional[CommGroup] = None,
+              sync_op: bool = True):
+    # SPMD: one logical value — broadcast is identity
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[CommGroup] = None, sync_op: bool = True):
+    group = group or _default_group()
+    if tensor_list is not None:
+        return Tensor(_unwrap(tensor_list[0]))
+    return tensor
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[CommGroup] = None, sync_op: bool = True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def barrier(group: Optional[CommGroup] = None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def send(tensor, dst: int, group: Optional[CommGroup] = None,
+         sync_op: bool = True):
+    raise NotImplementedError(
+        "point-to-point send/recv: use ppermute inside shard_map (the PP "
+        "schedule does) — per-process p2p does not exist under SPMD")
+
+
+def recv(tensor, src: int, group: Optional[CommGroup] = None,
+         sync_op: bool = True):
+    raise NotImplementedError(
+        "point-to-point send/recv: use ppermute inside shard_map")
+
+
+class stream:
+    """paddle.distributed.stream.* namespace parity (sync collectives)."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(all_to_all)

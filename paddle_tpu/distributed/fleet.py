@@ -1,0 +1,109 @@
+"""paddle.distributed.fleet — the hybrid-parallel entry point.
+
+Reference parity: fleet/fleet.py + fleet/base — ``fleet.init(strategy)``
+building the HybridCommunicateGroup, ``distributed_model``,
+``distributed_optimizer``, rank/worker accessors.
+
+TPU-native design: init builds ONE jax Mesh from the strategy's hybrid
+degrees (topology.py) and sets it as the global auto-parallel mesh; model
+and optimizer "wrapping" attach sharding metadata instead of comm hooks —
+the compiled path (distributed/trainer.py ShardedTrainStep) consumes it
+and GSPMD emits all communication.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..common.errors import enforce
+from . import env as dist_env
+from .strategy import DistributedStrategy
+from .topology import HybridCommunicateGroup
+
+__all__ = ["init", "fleet", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_num", "worker_index", "is_first_worker", "barrier_worker"]
+
+_HCG: Optional[HybridCommunicateGroup] = None
+_STRATEGY: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init — build the device mesh from strategy.hybrid_configs."""
+    global _HCG, _STRATEGY
+    strategy = strategy or DistributedStrategy()
+    _STRATEGY = strategy
+    hybrid = strategy.hybrid
+    n_needed = (hybrid.dp_degree * hybrid.mp_degree * hybrid.pp_degree *
+                hybrid.sharding_degree * hybrid.sep_degree)
+    n_have = len(jax.devices())
+    if n_needed == 1 and n_have > 1:
+        # no explicit topology: default all devices to dp (reference
+        # behavior: fleet defaults to pure DP over visible devices)
+        hybrid.dp_degree = n_have
+    _HCG = HybridCommunicateGroup(hybrid)
+    from .auto_parallel import set_mesh
+    set_mesh(_HCG.mesh)
+    from .collective import _set_default_group
+    _set_default_group(_HCG.get_data_parallel_group())
+    return _HCG
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _STRATEGY
+
+
+def distributed_model(model):
+    """Attach the hybrid topology to the model.  Under GSPMD no wrapper
+    module is needed (no reducer/no pipeline runner objects); TP layers
+    already carry shardings, and ShardedTrainStep consumes the plan.  A
+    thin passthrough keeps the fleet API contract."""
+    enforce(_HCG is not None, "fleet.init() first")
+    model._hcg = _HCG
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    enforce(_HCG is not None, "fleet.init() first")
+    optimizer._hcg = _HCG
+    return optimizer
+
+
+def worker_num() -> int:
+    return dist_env.get_world_size()
+
+
+def worker_index() -> int:
+    return dist_env.get_rank()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from .collective import barrier
+    barrier()
+
+
+class _FleetFacade:
+    """``paddle.distributed.fleet`` object-style access (fleet.init, ...)"""
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_num = staticmethod(worker_num)
+    worker_index = staticmethod(worker_index)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+    DistributedStrategy = DistributedStrategy
+
+
+fleet = _FleetFacade()

@@ -1,0 +1,179 @@
+"""Tensor-parallel (Megatron-style) layers + sequence-parallel utilities.
+
+Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py —
+``VocabParallelEmbedding``, ``ColumnParallelLinear``, ``RowParallelLinear``
+— and fleet/utils/sequence_parallel_utils.py.
+
+TPU-native design (SURVEY.md §2.3): these layers do NOT issue collectives.
+They (1) annotate their weights with per-dim mesh axes (``dist_spec``)
+and (2) add ``with_sharding_constraint`` hints on activations when
+tracing.  The XLA SPMD partitioner then inserts exactly the
+allgather/allreduce pattern Megatron hand-codes — identical math, zero
+hand-written communication.  Outside a mesh/jit context they behave as
+ordinary layers (single-device semantics), so the same model code runs
+everywhere.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..common.errors import enforce
+from ..nn import functional as F
+from ..nn.common import Embedding, Linear
+from ..nn.initializer import Normal, XavierNormal
+from ..nn.layer import Layer
+from ..tensor import Tensor, apply_op
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "mark_as_sequence_parallel_parameter", "ScatterOp", "GatherOp",
+           "sharding_constraint"]
+
+
+def _mesh():
+    from .fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return hcg.mesh if hcg is not None else None
+
+
+def sharding_constraint(x, *spec_entries):
+    """Activation sharding hint — no-op outside tracing/mesh context."""
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    val = x.value if isinstance(x, Tensor) else x
+    if not isinstance(val, jax.core.Tracer):
+        return x
+    spec = PartitionSpec(*spec_entries)
+
+    def _constrain(a):
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+    _constrain.__name__ = "sharding_constraint"
+    return apply_op(_constrain, x)
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on OUT (columns) over the mp axis.
+
+    gather_output=False leaves activations mp-sharded on the feature dim
+    (fed to a RowParallelLinear), True re-replicates them.
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = True, fuse_matmul_bias: bool = False,
+                 mp_group=None, name: Optional[str] = None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = True
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else XavierNormal())
+        self.weight.dist_spec = (None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = sharding_constraint(out, *([None] * (out.ndim - 1)), None)
+        else:
+            out = sharding_constraint(out, *([None] * (out.ndim - 1)), "mp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on IN (rows) over the mp axis; the partial
+    matmul results are summed by the partitioner (Megatron's forward
+    allreduce — emitted automatically)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None,
+                 name: Optional[str] = None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = True
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else XavierNormal())
+        self.weight.dist_spec = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = sharding_constraint(x, *([None] * (x.ndim - 1)), "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return sharding_constraint(out, *([None] * (out.ndim - 1)), None)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding weight [vocab, hidden] sharded on vocab over mp."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name: Optional[str] = None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else Normal(0.0, 0.02))
+        self.weight.dist_spec = ("mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """fleet parallel_cross_entropy: CE over mp-sharded logits.  GSPMD
+    partitions the log-softmax reduction across the mp axis itself."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# -- sequence parallel (Megatron-SP) ----------------------------------------
+
+def mark_as_sequence_parallel_parameter(parameter: Tensor):
+    """fleet sequence_parallel_utils parity: under GSPMD the SP grad
+    allreduce bookkeeping is emitted by the partitioner — pure no-op."""
+    return parameter
+
+
+class ScatterOp:
+    """Scatter sequence dim across mp (enter an SP region)."""
+
+    @staticmethod
+    def apply(x):
+        return sharding_constraint(x, None, "mp",
+                                   *([None] * (x.ndim - 2)))
+
+
+class GatherOp:
+    """Gather sequence dim back (exit an SP region)."""
+
+    @staticmethod
+    def apply(x):
+        return sharding_constraint(x, *([None] * x.ndim))

@@ -1,0 +1,130 @@
+"""Hybrid-parallel topology → one jax device Mesh.
+
+Reference parity: fleet/base/topology.py ``HybridCommunicateGroup`` — the
+cartesian [dp, pp, sharding, mp, sep] process topology with one NCCL ring
+per axis per coordinate.
+
+TPU-native design (SURVEY.md §2.3): ALL axes live in ONE
+``jax.sharding.Mesh`` with named axes ``(dp, sharding, sep, mp)``(+ep
+aliased onto sharding×sep as in DeepSpeed-MoE, pp as leading axis for the
+stage loop).  There are no per-axis communicators to manage — GSPMD emits
+the collectives from shardings; the group accessors below return
+axis-name handles usable in shard_map/PartitionSpec, keeping the fleet
+API shape.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..common.errors import enforce
+from .strategy import HybridConfig
+
+__all__ = ["HybridCommunicateGroup", "CommGroup", "build_mesh"]
+
+AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+
+def build_mesh(hybrid: HybridConfig, devices: Optional[Sequence] = None
+               ) -> Mesh:
+    """Mesh with axis order (pp, dp, sharding, sep, mp) — the reference's
+    topology order, which also places mp on the innermost (fastest-ICI)
+    axis, matching TPU torus locality best practice (scaling-book recipe:
+    innermost mesh dim ↔ highest-bandwidth links)."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = (hybrid.pp_degree, hybrid.dp_degree, hybrid.sharding_degree,
+             hybrid.sep_degree, hybrid.mp_degree)
+    n = int(np.prod(shape))
+    enforce(n <= len(devices),
+            f"topology {shape} needs {n} devices, have {len(devices)}")
+    dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+class CommGroup:
+    """Axis-handle standing in for the reference's ProcessGroup: carries
+    the mesh + axis names; collectives inside shard_map reference
+    ``group.axis_name``."""
+
+    def __init__(self, mesh: Mesh, axis_names: Tuple[str, ...]):
+        self.mesh = mesh
+        self.axis_names = axis_names if isinstance(axis_names, tuple) \
+            else (axis_names,)
+
+    @property
+    def axis_name(self):
+        return self.axis_names[0] if len(self.axis_names) == 1 \
+            else self.axis_names
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axis_names]))
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single-controller SPMD: rank is resolved inside shard_map
+
+    def __repr__(self):
+        return f"CommGroup(axes={self.axis_names}, nranks={self.nranks})"
+
+
+class HybridCommunicateGroup:
+    def __init__(self, hybrid: HybridConfig,
+                 devices: Optional[Sequence] = None):
+        self._hybrid = hybrid
+        self.mesh = build_mesh(hybrid, devices)
+        self.global_mesh = self.mesh
+
+    # -- degrees (fleet API names) ------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._hybrid.dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._hybrid.mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._hybrid.pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._hybrid.sharding_degree
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._hybrid.sep_degree
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self._hybrid.ep_degree
+
+    # -- groups --------------------------------------------------------------
+    def get_data_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("dp",))
+
+    def get_model_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("mp",))
+
+    def get_pipe_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("pp",))
+
+    def get_sharding_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("sharding",))
+
+    def get_sep_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, ("sep",))
+
+    def get_expert_parallel_group(self) -> CommGroup:
+        # EP reuses dp×sharding capacity (DeepSpeed-MoE style folding)
+        return CommGroup(self.mesh, ("dp", "sharding"))
+
+    def get_check_parallel_group(self) -> CommGroup:
+        return CommGroup(self.mesh, AXES)
+
+    # batch/replica axes used for data sharding in the compiled path
+    def data_axes(self) -> Tuple[str, ...]:
+        return ("dp", "sharding")
+
+    def topology(self):
+        return self.mesh.shape

@@ -1,0 +1,96 @@
+"""ShardedTrainStep — the hybrid-parallel compiled training step.
+
+Reference parity: the whole fleet hybrid-parallel runtime path
+(SURVEY.md §3.3): DataParallel reducer + GroupSharded stages + mp layer
+collectives + grad-clip cross-group allreduces, fused here into ONE
+pjit'd XLA program whose communication is emitted by the SPMD
+partitioner over the mesh (the TPU-native replacement for the python
+1F1B/NCCL orchestration loop).
+
+Usage:
+    fleet.init(strategy)                       # builds the mesh
+    step = ShardedTrainStep(model, loss_fn, opt, stage=2)
+    loss = step(batch)                         # batch: numpy/jax pytree
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..common.errors import enforce
+from ..jit.train import CompiledTrainStep, _to_arrays
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+from .fleet import get_hybrid_communicate_group, get_strategy
+from .sharding import ShardingPlan
+
+__all__ = ["ShardedTrainStep"]
+
+
+class ShardedTrainStep(CompiledTrainStep):
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
+                 stage: Optional[int] = None, seed: int = 0,
+                 donate: bool = True):
+        hcg = get_hybrid_communicate_group()
+        enforce(hcg is not None, "fleet.init() before ShardedTrainStep")
+        self.mesh = hcg.mesh
+        if stage is None:
+            stage = getattr(model, "_sharding_stage", None)
+            if stage is None:
+                strat = get_strategy()
+                stage = strat.sharding_configs.stage if (strat and
+                                                         strat.sharding) else 1
+        super().__init__(model, loss_fn, optimizer, seed=seed, donate=donate)
+        self.plan = ShardingPlan(model, self.mesh, stage=stage)
+        # place initial state onto the mesh
+        self.state = jax.tree_util.tree_map(
+            jax.device_put, self.state, self.plan.state_shardings(self.state))
+
+    def _build(self):
+        super()._build()
+        inner = self._step_fn
+        shardings = self.plan.state_shardings(self.state)
+        # re-jit with explicit state shardings so donation + placement are
+        # stable; batch/lr/key shardings are propagated by XLA
+        import jax as _jax
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        from ..autograd import tape
+        from ..nn.layer import functional_state
+        from ..ops import random as _random
+        from ..tensor import Tensor
+
+        def step(state, batch, key, lr):
+            def pure_loss(p):
+                batch_t = _jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), batch)
+                with tape.no_grad():
+                    with functional_state(model, p):
+                        with _random.rng_guard(key):
+                            out = loss_fn(model, batch_t)
+                return out.value if isinstance(out, Tensor) else out
+
+            loss, grads = _jax.value_and_grad(pure_loss)(state["params"])
+            new_params, new_opt = optimizer.apply_gradients(
+                state["params"], grads, state["opt"], lr=lr)
+            return {"params": new_params, "opt": new_opt}, loss
+
+        self._step_fn = _jax.jit(
+            step,
+            in_shardings=(shardings,
+                          None, None, None),
+            out_shardings=(shardings, None),
+            donate_argnums=(0,) if self._donate else ())
+
+    def __call__(self, batch):
+        if self._step_fn is None:
+            self._build()
+        self._key, sub = jax.random.split(self._key)
+        lr = self.optimizer.get_lr()
+        batch = self.plan.shard_batch(_to_arrays(batch))
+        self.state, loss = self._step_fn(self.state, batch, sub, lr)
+        sched = self.optimizer._lr_scheduler
+        if sched is not None:
+            sched.step()
+        return loss

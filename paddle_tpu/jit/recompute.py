@@ -1,0 +1,56 @@
+"""Recompute (activation checkpointing / rematerialization).
+
+Reference parity: paddle.distributed.fleet.utils.recompute (+
+RecomputeConfig in DistributedStrategy) — re-run a layer's forward in
+backward to trade FLOPs for memory.  TPU-native: ``jax.checkpoint``
+(remat) applied to the layer's pure function, which XLA schedules —
+strictly better than the reference's python re-execution (fusion + no
+python in the bwd).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer import Layer, functional_state
+from ..tensor import Tensor, apply_op
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` under rematerialization.
+
+    Works both eagerly (no-op semantics, correct grads) and inside the
+    compiled train step (where it actually saves memory).
+    """
+    layer = function if isinstance(function, Layer) else None
+    fn = function.forward if layer is not None else function
+
+    if layer is not None:
+        named = dict(layer.named_parameters())
+        names = list(named.keys())
+
+        def raw(param_list, *arg_arrays):
+            def inner(param_list, *arg_arrays):
+                tensors = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), list(arg_arrays))
+                with functional_state(layer, dict(zip(names, param_list))):
+                    out = fn(*tensors, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda t: t.value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            return jax.checkpoint(inner)(param_list, *arg_arrays)
+        raw.__name__ = "recompute"
+        return apply_op(raw, [named[n] for n in names], *args)
+
+    def raw_fn(*arg_arrays):
+        def inner(*arg_arrays):
+            tensors = jax.tree_util.tree_map(
+                lambda a: Tensor(a, stop_gradient=True), list(arg_arrays))
+            out = fn(*tensors, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+        return jax.checkpoint(inner)(*arg_arrays)
+    raw_fn.__name__ = "recompute"
+    return apply_op(raw_fn, *args)

@@ -1,2 +1,4 @@
 from . import gpt
+from . import llama
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion

@@ -41,7 +41,7 @@ class Tensor:
     (paddle semantics); ``Parameter`` flips it to False."""
 
     __slots__ = ("_value", "_stop_gradient", "_grad", "_node", "_out_idx",
-                 "name", "__weakref__")
+                 "name", "dist_spec", "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -51,6 +51,9 @@ class Tensor:
         self._node: Optional[tape.GradNode] = None
         self._out_idx: int = 0
         self.name = name
+        # per-tensor-dim mesh axis annotation (PartitionSpec entries) set by
+        # TP/sharded layers; consumed by the distributed sharding planner
+        self.dist_spec = None
 
     # -- core properties ----------------------------------------------------
     @property
