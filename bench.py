@@ -1,0 +1,149 @@
+"""Headline benchmark: Llama-3-family pretraining tokens/sec/chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference's headline metric is Llama-3-8B pretraining tokens/sec/chip
+with MFU >= 40% as the north star (BASELINE.md).  This bench runs a
+compiled (jit, donated-state) bf16 training step of the Llama-3
+architecture at the largest config that fits the local chip's HBM,
+measures steady-state tokens/sec, and reports MFU vs the 40% target as
+``vs_baseline`` (no reference-published numbers exist: BASELINE.json
+``published`` is {}).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Peak dense bf16 FLOP/s and HBM bytes per chip, by normalized
+# PJRT device_kind substring (e.g. "TPU v5 lite" -> v5lite).
+_CHIP_TABLE = [
+    ("v6e", 918e12, 32e9), ("v6", 918e12, 32e9), ("v5p", 459e12, 95e9),
+    ("v5e", 197e12, 16e9), ("v5lite", 197e12, 16e9), ("v4", 275e12, 32e9),
+    ("v3", 123e12, 16e9), ("v2", 46e12, 8e9),
+]
+
+
+def _chip_info(kind: str):
+    k = kind.lower().replace(" ", "").replace("tpu", "")
+    for sub, peak, hbm in _CHIP_TABLE:
+        if sub in k:
+            return peak, hbm
+    return None, None
+
+
+# (name, hidden, intermediate, layers, heads, kv_heads, batch)
+_LADDER = [
+    ("llama3-8b", 4096, 14336, 32, 32, 8, 8),
+    ("llama-3b", 3072, 8192, 26, 24, 8, 8),
+    ("llama-1b", 2048, 8192, 16, 16, 8, 8),
+    ("llama-410m", 1024, 4096, 12, 16, 8, 8),
+    ("llama-tiny", 256, 512, 4, 8, 4, 8),
+]
+
+_SEQ = 2048
+_VOCAB = 32000  # reduced from 128256: bench is compute-shape, not tokenizer
+
+
+def _param_count(h, i, layers, heads, kv, vocab):
+    head_dim = h // heads
+    attn = h * heads * head_dim + 2 * h * kv * head_dim + heads * head_dim * h
+    mlp = 3 * h * i
+    per_layer = attn + mlp + 2 * h
+    return layers * per_layer + 2 * vocab * h + h
+
+
+def _pick_config(hbm_bytes):
+    for name, h, i, layers, heads, kv, batch in _LADDER:
+        n = _param_count(h, i, layers, heads, kv, _VOCAB)
+        # bf16 param + bf16 grad + 2x f32 adam moments = 12 B/param;
+        # fp32 logits + their grad dominate activations (8 B/logit);
+        # plus remat'd activation/workspace headroom.
+        logits = batch * _SEQ * _VOCAB * 8
+        acts = batch * _SEQ * h * layers * 4
+        need = (n * 12 + logits + acts) * 1.25 + 1e9
+        if need <= hbm_bytes:
+            return name, h, i, layers, heads, kv, batch, n
+    name, h, i, layers, heads, kv, batch = _LADDER[-1]
+    return name, h, i, layers, heads, kv, batch, _param_count(
+        h, i, layers, heads, kv, _VOCAB)
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import CompiledTrainStep
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         LlamaPretrainingCriterion)
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    peak, hbm_table = _chip_info(kind)
+    stats = {}
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        pass
+    hbm = stats.get("bytes_limit") or hbm_table or 8e9
+    on_tpu = dev.platform not in ("cpu",)
+
+    name, h, i, layers, heads, kv, batch, n_params = _pick_config(
+        hbm if on_tpu else 4e9)
+    seq = _SEQ if on_tpu else 256
+    cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=h,
+                      intermediate_size=i, num_hidden_layers=layers,
+                      num_attention_heads=heads, num_key_value_heads=kv,
+                      max_position_embeddings=seq, recompute=True)
+
+    model = LlamaForCausalLM(cfg)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 grad_clip=paddle.ClipGradByGlobalNorm(1.0))
+
+    def loss_fn(m, b):
+        return criterion(m(b["input_ids"]), b["labels"])
+
+    step = CompiledTrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, _VOCAB, size=(batch, seq), dtype=np.int32)
+    data = {"input_ids": ids, "labels": ids}
+
+    # warmup / compile
+    loss = step(data)
+    jax.block_until_ready(loss)
+    loss = step(data)
+    jax.block_until_ready(loss)
+
+    iters = 5 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    flops_per_token = 6 * n_params  # fwd+bwd dense FLOPs (remat adds ~fwd)
+    mfu = (flops_per_token * tokens_per_sec / peak) if peak else None
+    vs_baseline = (mfu / 0.40) if mfu is not None else None
+
+    print(json.dumps({
+        "metric": f"{name}_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4) if vs_baseline else None,
+        "extra": {"device_kind": kind, "params": n_params,
+                  "batch": batch, "seq": seq, "mfu": round(mfu, 4)
+                  if mfu is not None else None,
+                  "final_loss": float(np.asarray(jax.device_get(loss)))},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,8 +72,17 @@ class ProcessMesh:
             self._mesh = mesh
             self.dim_names = list(mesh.axis_names)
         else:
-            arr = np.asarray(mesh if mesh is not None else
-                             range(len(jax.devices())))
+            if mesh is None and (shape is not None or
+                                 process_ids is not None):
+                ids = (process_ids if process_ids is not None
+                       else range(int(np.prod(shape))))
+                arr = np.asarray(ids)
+                if shape is not None:
+                    arr = arr.reshape(shape)
+            elif mesh is not None:
+                arr = np.asarray(mesh)
+            else:
+                arr = np.asarray(range(len(jax.devices())))
             devices = np.asarray(jax.devices())[arr.reshape(-1)]
             self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
             self._mesh = Mesh(devices.reshape(arr.shape),
@@ -185,7 +194,8 @@ def _shard_param(p, mesh, placements):
     Tensor.__init__(new, arr, stop_gradient=p.stop_gradient)
     new.trainable = getattr(p, "trainable", True)
     new.optimize_attr = getattr(p, "optimize_attr", {"learning_rate": 1.0})
-    new.regularizer = None
+    new.regularizer = getattr(p, "regularizer", None)
+    new.dist_spec = getattr(p, "dist_spec", None)  # keep TP annotations
     new.is_distributed = True
     new.name = p.name
     return new

@@ -78,6 +78,17 @@ def new_group(ranks: Optional[List[int]] = None, backend=None,
                                       else axis))
     else:
         g = hcg.get_check_parallel_group()
+        if ranks is not None:
+            from .env import get_world_size
+            # "all ranks" in either unit: process count (paddle's
+            # get_world_size idiom) or mesh device count
+            all_ranks = (list(range(get_world_size())),
+                         list(range(g.nranks)))
+            if sorted(ranks) not in all_ranks:
+                raise NotImplementedError(
+                    f"new_group(ranks={ranks}): arbitrary rank subsets do "
+                    "not map onto the SPMD mesh — pass axis='dp'/'mp'/... "
+                    "to get the per-axis group instead")
     _GROUPS[id(g)] = g
     return g
 
@@ -132,9 +143,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
     group = group or _default_group()
     val = _unwrap(tensor)
     if _is_traced(val):
-        fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
-               ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}
-        out = fns[op](val, group.axis_name)
+        if op == ReduceOp.PROD:
+            # no lax.pprod: gather the axis and reduce locally
+            gathered = lax.all_gather(val, group.axis_name)
+            out = jnp.prod(gathered, axis=0)
+        else:
+            fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+                   ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}
+            enforce(op in fns, f"unsupported ReduceOp {op!r}")
+            out = fns[op](val, group.axis_name)
         return Tensor(out) if isinstance(tensor, Tensor) else out
     # concrete global array: already globally reduced under SPMD
     return tensor

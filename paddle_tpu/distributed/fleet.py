@@ -42,8 +42,10 @@ def init(role_maker=None, is_collective: bool = True,
     n_have = len(jax.devices())
     if n_needed == 1 and n_have > 1:
         # no explicit topology: default all devices to dp (reference
-        # behavior: fleet defaults to pure DP over visible devices)
+        # behavior: fleet defaults to pure DP over visible devices).
+        # Persist into the strategy so get_strategy() agrees with the mesh.
         hybrid.dp_degree = n_have
+        strategy.hybrid_configs["dp_degree"] = n_have
     _HCG = HybridCommunicateGroup(hybrid)
     from .auto_parallel import set_mesh
     set_mesh(_HCG.mesh)

@@ -49,37 +49,13 @@ class ShardedTrainStep(CompiledTrainStep):
             jax.device_put, self.state, self.plan.state_shardings(self.state))
 
     def _build(self):
-        super()._build()
-        inner = self._step_fn
+        # same fused step as the parent, jitted with explicit state
+        # shardings so donation + placement are stable; batch/lr/key
+        # shardings are propagated by XLA
         shardings = self.plan.state_shardings(self.state)
-        # re-jit with explicit state shardings so donation + placement are
-        # stable; batch/lr/key shardings are propagated by XLA
-        import jax as _jax
-        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
-        from ..autograd import tape
-        from ..nn.layer import functional_state
-        from ..ops import random as _random
-        from ..tensor import Tensor
-
-        def step(state, batch, key, lr):
-            def pure_loss(p):
-                batch_t = _jax.tree_util.tree_map(
-                    lambda a: Tensor(a, stop_gradient=True), batch)
-                with tape.no_grad():
-                    with functional_state(model, p):
-                        with _random.rng_guard(key):
-                            out = loss_fn(model, batch_t)
-                return out.value if isinstance(out, Tensor) else out
-
-            loss, grads = _jax.value_and_grad(pure_loss)(state["params"])
-            new_params, new_opt = optimizer.apply_gradients(
-                state["params"], grads, state["opt"], lr=lr)
-            return {"params": new_params, "opt": new_opt}, loss
-
-        self._step_fn = _jax.jit(
-            step,
-            in_shardings=(shardings,
-                          None, None, None),
+        self._step_fn = jax.jit(
+            self._make_step(),
+            in_shardings=(shardings, None, None, None),
             out_shardings=(shardings, None),
             donate_argnums=(0,) if self._donate else ())
 

@@ -58,7 +58,8 @@ class CompiledTrainStep:
         self._step_fn = None
         self._donate = donate
 
-    def _build(self):
+    def _make_step(self):
+        """The raw (un-jitted) fused step fn: fwd+bwd+clip+update."""
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
 
         def step(state, batch, key, lr):
@@ -76,8 +77,11 @@ class CompiledTrainStep:
                 state["params"], grads, state["opt"], lr=lr)
             return {"params": new_params, "opt": new_opt}, loss
 
+        return step
+
+    def _build(self):
         self._step_fn = jax.jit(
-            step, donate_argnums=(0,) if self._donate else ())
+            self._make_step(), donate_argnums=(0,) if self._donate else ())
 
     def __call__(self, batch) -> jax.Array:
         if self._step_fn is None:

@@ -93,6 +93,23 @@ def apply_rotary_pos_emb(q, k, cos, sin):
     return apply_op(_apply_rope_raw, q, k, cos, sin)
 
 
+def _seq_parallel_raw(x):
+    """Pin hidden states [B,S,H] to batch-over-(dp,sharding) and
+    seq-over-sep — the Megatron-SP/context-parallel activation layout;
+    GSPMD reshards attention around it (fleet sequence_parallel_utils
+    analog)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..distributed.auto_parallel import get_mesh
+    pm = get_mesh()
+    if pm is None or pm.mesh.shape.get("sep", 1) <= 1:
+        return x
+    spec = PartitionSpec(("dp", "sharding"), "sep", None)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(pm.mesh, spec))
+
+
 class LlamaAttention(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -116,6 +133,7 @@ class LlamaAttention(Layer):
         self.k_proj.weight.dist_spec = (None, "mp")
         self.v_proj.weight.dist_spec = (None, "mp")
         self.o_proj.weight.dist_spec = ("mp", None)
+        self.use_flash = config.use_flash_attention
 
     def forward(self, x, cos_sin, cache=None):
         b, s, _ = x.shape
@@ -127,7 +145,9 @@ class LlamaAttention(Layer):
         if cache is not None:
             k = P.concat([cache[0], k], axis=1)
             v = P.concat([cache[1], v], axis=1)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn_fn = (F.scaled_dot_product_attention if self.use_flash
+                   else F.scaled_dot_product_attention_ref)
+        out = attn_fn(q, k, v, is_causal=True)
         out = P.reshape(out, [b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if cache is not None:
@@ -207,6 +227,8 @@ class LlamaModel(Layer):
         past = 0 if caches is None else (
             caches[0][0].shape[1] if caches[0] is not None else 0)
         x = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            x = apply_op(_seq_parallel_raw, x)
         cos_sin = self._cos_sin(past, s)
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):

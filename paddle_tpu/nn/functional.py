@@ -30,6 +30,21 @@ from ..runtime.device import is_compiled_with_tpu
 batch_norm = _api.batch_norm
 scaled_dot_product_attention_ref = _api.scaled_dot_product_attention
 
+_FLASH_RAW = 0  # unresolved; becomes the kernel fn or None after first use
+
+
+def _flash_kernel():
+    """One-time cached import of the Pallas flash kernel (a failing
+    import must not re-run per attention call on the hot path)."""
+    global _FLASH_RAW
+    if _FLASH_RAW == 0:
+        try:
+            from ..ops.pallas.flash_attention import flash_attention_raw
+            _FLASH_RAW = flash_attention_raw
+        except ImportError:
+            _FLASH_RAW = None
+    return _FLASH_RAW
+
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
@@ -48,12 +63,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         and is_compiled_with_tpu()
     )
     if use_pallas:
-        from ..ops.pallas.flash_attention import flash_attention_raw
-        try:
-            return apply_op(flash_attention_raw, query, key, value,
-                            causal=is_causal)
-        except Exception:  # pragma: no cover — pallas lowering unavailable
-            pass
+        kernel = _flash_kernel()
+        if kernel is not None:
+            try:
+                return apply_op(kernel, query, key, value, causal=is_causal)
+            except Exception:  # pragma: no cover — lowering unavailable
+                pass
     return _api.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training)
