@@ -48,6 +48,7 @@ class LlamaConfig:
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
+    fuse_linear_cross_entropy: bool = True  # chunked lm_head+CE (training)
 
 
 def llama3_8b_config() -> LlamaConfig:
@@ -265,14 +266,27 @@ class LlamaForCausalLM(Layer):
     def model(self):
         return self.llama
 
-    def forward(self, input_ids, caches=None):
+    def forward(self, input_ids, caches=None, labels=None):
         out = self.llama(input_ids, caches)
         hidden = out[0] if caches is not None else out
+        if labels is not None and self.config.fuse_linear_cross_entropy:
+            # training fast path: never materializes [B,S,V] logits
+            if self.lm_head is None:
+                loss = F.fused_linear_cross_entropy(
+                    hidden, self.llama.embed_tokens.weight, labels,
+                    transpose_weight=True)
+            else:
+                loss = F.fused_linear_cross_entropy(
+                    hidden, self.lm_head.weight, labels)
+            return (loss, out[1]) if caches is not None else loss
         if self.lm_head is None:
             logits = P.matmul(hidden, self.llama.embed_tokens.weight,
                               transpose_y=True)
         else:
             logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = LlamaPretrainingCriterion()(logits, labels)
+            return (loss, out[1]) if caches is not None else loss
         if caches is not None:
             return logits, out[1]
         return logits

@@ -14,7 +14,8 @@ from ..ops.api import (  # noqa: F401
     adaptive_avg_pool2d, adaptive_max_pool2d, avg_pool2d,
     binary_cross_entropy, binary_cross_entropy_with_logits, celu,
     conv1d, conv2d, conv2d_transpose, conv3d, cosine_similarity,
-    cross_entropy, dropout, elu, embedding, gelu, glu, group_norm,
+    cross_entropy, dropout, elu, embedding, fused_linear_cross_entropy,
+    gelu, glu, group_norm,
     gumbel_softmax, hardshrink, hardsigmoid, hardswish, hardtanh,
     instance_norm, interpolate, kl_div, l1_loss, label_smooth, layer_norm,
     leaky_relu, linear, log_softmax, logsigmoid, max_pool2d, maxout, mish,

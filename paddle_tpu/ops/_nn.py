@@ -571,6 +571,66 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     return _reduce(loss, reduction)
 
 
+def fused_linear_cross_entropy(x, weight, label, bias=None,
+                               ignore_index=-100, reduction="mean",
+                               transpose_weight=False, chunk_size=1024):
+    """Fused LM-head matmul + softmax cross-entropy, chunked over tokens.
+
+    Reference parity: phi fused kernels (fused_softmax_mask /
+    parallel cross-entropy-with-logits, SURVEY.md §2.1) — the paddle
+    recipe computes full [N, V] logits then CE; at V=32k-128k the fp32
+    logits and their gradient dominate HBM.  TPU-native design: scan
+    over token chunks, computing each chunk's logits inside a
+    ``jax.checkpoint`` region so they are recomputed (not stored) in
+    backward — peak memory drops from O(N·V) to O(chunk·V) while the
+    matmuls stay MXU-sized.
+
+    x: [..., H]; weight: [H, V] (paddle Linear layout) or [V, H] with
+    ``transpose_weight=True`` (tied-embedding layout); label: [...].
+    """
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h)
+    lab = label.reshape(-1)
+    n = x2.shape[0]
+    c = min(chunk_size, n)
+    pad = (-n) % c
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, h), x2.dtype)], axis=0)
+        lab = jnp.concatenate(
+            [lab, jnp.full((pad,), ignore_index, lab.dtype)], axis=0)
+    n_chunks = (n + pad) // c
+    xc_all = x2.reshape(n_chunks, c, h)
+    lab_all = lab.reshape(n_chunks, c)
+
+    @jax.checkpoint
+    def chunk_loss(xc, lc):
+        logits = jnp.dot(xc, weight.T if transpose_weight else weight,
+                         preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        safe = jnp.where(lc == ignore_index, 0, lc)
+        tgt = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        valid = lc != ignore_index
+        per_tok = jnp.where(valid, lse - tgt, 0.0)
+        return per_tok, valid.astype(jnp.float32)
+
+    def body(carry, inp):
+        per_tok, valid = chunk_loss(*inp)
+        if reduction == "none":
+            return carry, per_tok
+        return (carry[0] + jnp.sum(per_tok), carry[1] + jnp.sum(valid)), None
+
+    if reduction == "none":
+        _, per = jax.lax.scan(body, (0.0, 0.0), (xc_all, lab_all))
+        return per.reshape(-1)[:n].reshape(label.shape)
+    (total, count), _ = jax.lax.scan(body, (0.0, 0.0), (xc_all, lab_all))
+    if reduction == "sum":
+        return total
+    return total / jnp.maximum(count, 1.0)
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
     loss = -jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
     valid = label != ignore_index

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — the PHI fused-kernel library analog.
+
+Reference parity: paddle/phi/kernels/fusion/ + flash_attn_kernel
+(SURVEY.md §2.1) — here written as Mosaic/Pallas kernels tiled for the
+MXU instead of CUDA.
+"""

@@ -1,0 +1,337 @@
+"""FlashAttention-2 for TPU (Pallas/Mosaic).
+
+Reference parity: phi/kernels/gpu/flash_attn_kernel (the reference's
+external flash-attn CUDA library, SURVEY.md §2.1).  TPU-native design:
+online-softmax blockwise attention tiled for the MXU — Q blocks stay
+resident in VMEM while K/V blocks stream through the innermost grid
+dimension (Pallas double-buffers the HBM→VMEM DMAs); causal handling
+skips fully-masked K/V blocks; GQA reads each KV head block once per
+query-head group via the BlockSpec index map.  Backward is the
+FlashAttention-2 split: a dQ kernel (grid over Q, stream K/V) and a
+dK/dV kernel (grid over KV, stream Q), both using the saved
+per-row logsumexp instead of re-doing online softmax.
+
+Layout: [B, H, S, D] inside the kernels; the public wrapper takes the
+framework's [B, S, H, D] and transposes (fused by XLA into the
+surrounding QKV projection reshapes).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_raw", "flash_attention_bhsd"]
+
+_NEG_INF = float(-1e30)
+_LANES = 128  # m/l scratch broadcast across one lane tile
+
+
+def _pick_blocks(sq: int, sk: int, d: int):
+    bq = min(512, sq)
+    bk = min(512, sk)
+    while sq % bq:
+        bq //= 2
+    while sk % bk:
+        bk //= 2
+    return max(bq, 8), max(bk, 8)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: K block strictly above the diagonal band is fully masked
+    run = True
+    if causal:
+        run = ik * bk < (iq + 1) * bq
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (iq * bq + rows) >= (ik * bk + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0][:, None]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=1)[:, None]                  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                               # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                      # [bq, 1]
+        l_new = l_scr[:, 0][:, None] * alpha + jnp.sum(p, axis=1)[:, None]
+        v = v_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_scr[:, 0][:, None]
+        # guard fully-masked rows (can't happen for causal square, but
+        # keeps the kernel total for degenerate shapes)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = (m_scr[...] + jnp.log(l_safe))[:, :1]          # [bq, 1]
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
+    nq, nk = sq // bq, sk // bk
+    scale = 1.0 / math.sqrt(d)
+
+    grid = (b, h, nq, nk)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dQ kernel — grid over Q blocks, stream K/V
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = ik * bk < (iq + 1) * bq
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)                 # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                            # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                        # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (iq * bq + rows) >= (ik * bk + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                                 # [bq, bk]
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dK/dV kernel — grid over KV blocks, stream Q
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, nq):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = ik * bk < (iq + 1) * bq
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (iq * bq + rows) >= (ik * bk + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                                 # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, d]
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, bq, bk, res, do):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
+    nq, nk = sq // bq, sk // bk
+    scale = 1.0 / math.sqrt(d)
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)                                  # [b, h, sq]
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per query head; GQA group-sum happens below
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, ik, iq, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, ik, iq, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_bhsd(q, k, v, causal: bool, bq: int, bk: int):
+    """[B, H, S, D] flash attention; K/V may have fewer heads (GQA)."""
+    out, _ = _fwd(q, k, v, causal=causal, bq=bq, bk=bk)
+    return out
+
+
+def _fwd_rule(q, k, v, causal, bq, bk):
+    out, lse = _fwd(q, k, v, causal=causal, bq=bq, bk=bk)
+    return out, (q, k, v, out, lse)
+
+
+flash_attention_bhsd.defvjp(_fwd_rule, _bwd)
+
+
+def flash_attention_raw(q, k, v, causal: bool = False):
+    """[B, S, H, D] entry used by F.scaled_dot_product_attention.
+
+    Raises on shapes the kernel does not cover (caller falls back to the
+    jnp reference): cross-length causal decode, tiny/odd dims.
+    """
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if causal and sq != sk:
+        raise NotImplementedError("causal flash kernel needs sq == sk")
+    if d not in (64, 128, 256) or h % hk or sq % 8 or sk % 8:
+        raise NotImplementedError("flash kernel shape constraints")
+    bq, bk = _pick_blocks(sq, sk, d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhsd(qt, kt, vt, causal, bq, bk)
+    return jnp.swapaxes(out, 1, 2)

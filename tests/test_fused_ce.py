@@ -1,0 +1,69 @@
+"""fused_linear_cross_entropy vs unfused logits+CE (values + grads)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import _nn
+
+
+def _setup(n=100, h=32, v=57, seed=0, ignore_frac=0.2):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, h)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((h, v)) * 0.1, jnp.float32)
+    lab = rng.integers(0, v, size=(n,))
+    lab[rng.random(n) < ignore_frac] = -100
+    return x, w, jnp.asarray(lab)
+
+
+def _unfused(x, w, lab):
+    logits = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    return _nn.cross_entropy(logits, lab, ignore_index=-100)
+
+
+def test_value_and_grads_match():
+    x, w, lab = _setup()
+
+    def fused(x, w):
+        # chunk_size 16 with n=100 also exercises the padding path
+        return _nn.fused_linear_cross_entropy(x, w, lab, chunk_size=16)
+
+    def unfused(x, w):
+        return _unfused(x, w, lab)
+
+    lf, gf = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+    lu, gu = jax.value_and_grad(unfused, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(lf), float(lu), rtol=1e-5)
+    for a, b in zip(gf, gu):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_transpose_weight_and_reductions():
+    x, w, lab = _setup(n=64, seed=1)
+    base = _nn.fused_linear_cross_entropy(x, w, lab, chunk_size=32)
+    wt = _nn.fused_linear_cross_entropy(x, w.T, lab, chunk_size=32,
+                                        transpose_weight=True)
+    np.testing.assert_allclose(float(base), float(wt), rtol=1e-6)
+    s = _nn.fused_linear_cross_entropy(x, w, lab, chunk_size=32,
+                                       reduction="sum")
+    per = _nn.fused_linear_cross_entropy(x, w, lab, chunk_size=32,
+                                         reduction="none")
+    assert per.shape == lab.shape
+    np.testing.assert_allclose(float(jnp.sum(per)), float(s), rtol=1e-6)
+
+
+def test_llama_forward_with_labels_matches_criterion():
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         LlamaPretrainingCriterion,
+                                         llama_tiny_config)
+    cfg = llama_tiny_config()
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(2)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, size=(2, 16), dtype=np.int64))
+    loss_fused = model(ids, labels=ids)
+    logits = model(ids)
+    loss_ref = LlamaPretrainingCriterion()(logits, ids)
+    np.testing.assert_allclose(float(loss_fused.numpy()),
+                               float(loss_ref.numpy()), rtol=2e-5)
