@@ -1,0 +1,204 @@
+"""Pipeline parallelism.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py
+(LayerDesc, SharedLayerDesc, PipelineLayer — layer-list segmentation) and
+fleet/meta_parallel/pipeline_parallel.py (PipelineParallel.train_batch:
+python 1F1B microbatch loop over NCCL p2p, SURVEY.md §3.3).
+
+TPU-native design: the reference's python-level schedule loop becomes ONE
+compiled SPMD program — ``gpipe_spmd`` runs a GPipe-style circulating
+pipeline inside ``jax.shard_map`` manual over ONLY the ``pp`` mesh axis
+(dp/sharding/mp stay auto, so GSPMD still lays out data/tensor/FSDP
+parallelism inside each stage).  Stage params are stacked on a leading
+axis sharded over ``pp``; activations rotate between stages with
+``lax.ppermute`` over ICI; backward is derived by jax.grad through the
+loop (GPipe schedule: all-forward then reversed all-backward, remat per
+stage via jax.checkpoint).  Bubble fraction = (S-1)/(M+S-1), same as
+1F1B; 1F1B's memory advantage is recovered with stage remat instead.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..common.errors import enforce
+from ..nn.layer import Layer
+from ..nn.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "gpipe_spmd"]
+
+
+# ---------------------------------------------------------------------------
+# The compiled SPMD pipeline engine
+# ---------------------------------------------------------------------------
+
+def _pvary(x, axis):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return jax.lax.pvary(x, (axis,))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
+                     n_params: int, n_extra: int, remat: bool):
+    """Build + cache the jitted shard_map engine (keyed on a *stable*
+    stage_fn object so eager loops don't re-trace every step)."""
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def inner(params_local, xm, *extra_local):
+        locals_ = [p[0] for p in params_local]
+        n_micro = xm.shape[0]
+        stage = jax.lax.axis_index(pp_axis)
+        nstage = jax.lax.axis_size(pp_axis)
+        carry = _pvary(jnp.zeros(xm.shape[1:], xm.dtype), pp_axis)
+        outs = _pvary(jnp.zeros(xm.shape, xm.dtype), pp_axis)
+
+        def step(t, state):
+            carry, outs = state
+            feed = _pvary(xm[jnp.minimum(t, n_micro - 1)], pp_axis)
+            inp = jnp.where(stage == 0, feed, carry)
+            y = fn(locals_, inp, *extra_local)
+            out_idx = jnp.maximum(t - (nstage - 1), 0)
+            keep = jnp.logical_and(stage == nstage - 1,
+                                   t - (nstage - 1) >= 0)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(keep, y, outs[out_idx]), out_idx, 0)
+            nxt = jax.lax.ppermute(
+                y, pp_axis, [(i, (i + 1) % nstage) for i in range(nstage)])
+            return nxt, upd
+
+        carry, outs = jax.lax.fori_loop(
+            0, n_micro + nstage - 1, step, (carry, outs))
+        outs = jnp.where(stage == nstage - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(outs, pp_axis)
+
+    in_specs = (tuple(P(pp_axis) for _ in range(n_params)), P(),
+                *(P() for _ in range(n_extra)))
+    mapped = jax.shard_map(inner, mesh=mesh, axis_names={pp_axis},
+                           in_specs=in_specs, out_specs=P())
+    # jit wrapper: eager evaluation of checkpoint/scan inside shard_map is
+    # unsupported; under an outer jit this inlines
+    return jax.jit(mapped)
+
+
+def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
+               stage_fn: Callable, *extra,
+               mesh, pp_axis: str = "pp", remat: bool = True):
+    """Run ``stage_fn`` as a circulating SPMD pipeline.
+
+    params:   arrays stacked [n_stages, ...] (pp-sharded on dim 0);
+              n_stages must equal the ``pp_axis`` mesh size.
+    x_micro:  [n_micro, micro_batch, ...] input microbatches (replicated
+              over pp; may be sharded over data axes).
+    stage_fn: (local_params_list, h, *extra) -> h, applied by every
+              stage.  Pass a STABLE callable (module-level or cached) —
+              the compiled engine is cached keyed on it.
+    extra:    broadcast side inputs (e.g. rope tables), replicated.
+
+    Returns [n_micro, micro_batch, ...] outputs of the final stage.
+    """
+    n_stages = params[0].shape[0]
+    enforce(n_stages == mesh.shape[pp_axis],
+            f"stacked stage dim {n_stages} != mesh '{pp_axis}' size "
+            f"{mesh.shape[pp_axis]}")
+    fn = _jitted_pipeline(stage_fn, mesh, pp_axis, len(params),
+                          len(extra), remat)
+    return fn(tuple(params), x_micro, *extra)
+
+
+# ---------------------------------------------------------------------------
+# Paddle-parity layer-list API
+# ---------------------------------------------------------------------------
+
+class LayerDesc:
+    """Deferred layer constructor (fleet pp_layers.LayerDesc parity)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        enforce(issubclass(layer_cls, Layer) or callable(layer_cls),
+                "LayerDesc needs a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer whose parameters are shared across stages (e.g. tied
+    embedding/lm-head).  Under single-program SPMD the sharing is simply
+    object identity — the first build is reused."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """fleet.meta_parallel.PipelineLayer parity.
+
+    Holds the full layer list (single-program SPMD: every process owns
+    the whole model; stage placement is a sharding concern, not an
+    ownership concern).  ``forward`` runs the stack sequentially — the
+    semantics the reference's PipelineParallel produces.  The pipelined
+    *execution* is the compiled path: models with a uniform decoder
+    stack (e.g. LlamaForCausalLMPipe) lower it through gpipe_spmd.
+    """
+
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval: int = 0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._shared: dict = {}
+        built: List[Layer] = []
+        self.descs = list(layers)
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(self._shared[d.layer_name])
+                else:
+                    lyr = d.build_layer()
+                    self._shared[d.layer_name] = lyr
+                    built.append(lyr)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            else:
+                enforce(isinstance(d, Layer),
+                        "PipelineLayer accepts Layers or LayerDescs")
+                built.append(d)
+        self.run_function = LayerList(built)
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe") if hasattr(
+                topology, "get_dim") else 1
+        self._num_stages = num_stages or 1
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        s = self._num_stages
+        base, extra = divmod(n, s)
+        bounds = [0]
+        for i in range(s):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage: int) -> List[Layer]:
+        lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+        return list(self.run_function[lo:hi])
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def forward(self, x, *args, **kwargs):
+        for lyr in self.run_function:
+            x = lyr(x)
+        return x

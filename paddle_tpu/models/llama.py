@@ -12,6 +12,7 @@ routes through the fused flash path (F.scaled_dot_product_attention).
 """
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -22,14 +23,14 @@ from .. import ops as P
 from ..nn import functional as F
 from ..nn.common import Embedding, Linear
 from ..nn.container import LayerList
-from ..nn.initializer import Normal
+from ..nn.initializer import Constant, Normal
 from ..nn.layer import Layer
 from ..nn.norm import RMSNorm
 from ..tensor import Tensor, apply_op
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "LlamaPretrainingCriterion", "llama3_8b_config",
-           "llama_tiny_config", "apply_rotary_pos_emb"]
+           "LlamaForCausalLMPipe", "LlamaPretrainingCriterion",
+           "llama3_8b_config", "llama_tiny_config", "apply_rotary_pos_emb"]
 
 
 @dataclass
@@ -297,6 +298,187 @@ class LlamaForCausalLM(Layer):
         return [(P.zeros([batch_size, 0, c.num_key_value_heads, hd]),
                  P.zeros([batch_size, 0, c.num_key_value_heads, hd]))
                 for _ in range(c.num_hidden_layers)]
+
+
+def _attn_for_shape(q, k, v):
+    """Flash kernel when eligible, jnp oracle otherwise — both raw
+    (callable inside shard_map/scan).  Eligibility is owned by
+    flash_attention_raw itself (single source of the shape rules)."""
+    from ..common.flags import get_flag
+    from ..runtime.device import is_compiled_with_tpu
+    if get_flag("use_pallas") and is_compiled_with_tpu():
+        from ..ops.pallas.flash_attention import flash_attention_raw
+        try:
+            return flash_attention_raw(q, k, v, causal=True)
+        except NotImplementedError:
+            pass
+    from ..ops import _nn
+    return _nn.scaled_dot_product_attention(q, k, v, is_causal=True)
+
+
+def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
+    """One Llama decoder layer on raw arrays (mirrors LlamaDecoderLayer;
+    kept in sync by the pipe-vs-sequential parity test)."""
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+    iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+    b, s, _ = h.shape
+    hn = _nn.rms_norm(h, iln, epsilon=eps)
+    q = jnp.matmul(hn, qw).reshape(b, s, n_heads, head_dim)
+    k = jnp.matmul(hn, kw).reshape(b, s, n_kv, head_dim)
+    v = jnp.matmul(hn, vw).reshape(b, s, n_kv, head_dim)
+    q, k = _apply_rope_raw(q, k, cos, sin)
+    attn = _attn_for_shape(q, k, v).reshape(b, s, n_heads * head_dim)
+    h = h + jnp.matmul(attn, ow)
+    hn = _nn.rms_norm(h, pln, epsilon=eps)
+    ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
+    return h + jnp.matmul(ff, dw)
+
+
+@functools.lru_cache(maxsize=32)
+def _pipe_stage_fn(n_heads, n_kv, head_dim, eps):
+    """Stable per-config stage callable (the pipeline engine caches its
+    compiled form keyed on this object)."""
+    import jax
+
+    def stage_fn(locals_, h, cos, sin):
+        def body(h, lp):
+            return _decoder_layer_raw(lp, h, cos, sin, n_heads=n_heads,
+                                      n_kv=n_kv, head_dim=head_dim,
+                                      eps=eps), None
+        h, _ = jax.lax.scan(body, h, tuple(locals_))
+        return h
+
+    return stage_fn
+
+
+def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
+                    num_stages, n_micro, pp_axis="pp"):
+    """Decoder stack as an SPMD GPipe pipeline (raw jax level).
+
+    params: 9 stacked arrays, each [L, ...] (order of _decoder_layer_raw).
+    """
+    import jax
+
+    from ..distributed.auto_parallel import get_mesh
+    from ..distributed.pipeline import gpipe_spmd
+
+    n_layers = params[0].shape[0]
+    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps)
+
+    pm = get_mesh()
+    pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
+    if num_stages is None:
+        num_stages = pp
+
+    if pm is None or pp <= 1 or num_stages <= 1:
+        # no pipeline axis: plain scan over layers (single-chip / dp-only)
+        return stage_fn(list(params), x, cos, sin)
+
+    if n_layers % num_stages:
+        raise ValueError(
+            f"num_hidden_layers={n_layers} must divide evenly over "
+            f"pp_degree={num_stages} stages")
+    per_stage = n_layers // num_stages
+    stacked = [p.reshape((num_stages, per_stage) + p.shape[1:])
+               for p in params]
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f"batch size {b} must be divisible by n_microbatches={n_micro}")
+    xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+    out = gpipe_spmd(stacked, xm, stage_fn, cos, sin,
+                     mesh=pm.mesh, pp_axis=pp_axis)
+    return out.reshape(x.shape)
+
+
+class LlamaForCausalLMPipe(Layer):
+    """Pipeline-parallel Llama (PaddleNLP LlamaForCausalLMPipe parity).
+
+    Decoder-layer parameters are stacked on a leading layer axis that is
+    sharded over the ``pp`` mesh dim (plus the usual Megatron TP specs on
+    the trailing dims); embedding / final norm / lm-head run outside the
+    pipeline region.  Requires num_hidden_layers % pp_degree == 0.
+    """
+
+    def __init__(self, config: LlamaConfig, n_microbatches: int = 4):
+        super().__init__()
+        self.config = config
+        self.n_microbatches = n_microbatches
+        c = config
+        hd = c.hidden_size // c.num_attention_heads
+        self.head_dim = hd
+        init = Normal(0.0, c.initializer_range)
+        out_init = Normal(0.0, c.initializer_range /
+                          math.sqrt(2 * c.num_hidden_layers))
+        L, H = c.num_hidden_layers, c.hidden_size
+
+        def stacked(shape, ini, spec):
+            p = self.create_parameter([L] + shape, default_initializer=ini)
+            p.dist_spec = ("pp",) + spec
+            return p
+
+        self.input_ln = stacked([H], Constant(1.0), (None,))
+        self.q_w = stacked([H, c.num_attention_heads * hd], init,
+                           (None, "mp"))
+        self.k_w = stacked([H, c.num_key_value_heads * hd], init,
+                           (None, "mp"))
+        self.v_w = stacked([H, c.num_key_value_heads * hd], init,
+                           (None, "mp"))
+        self.o_w = stacked([c.num_attention_heads * hd, H], out_init,
+                           ("mp", None))
+        self.post_ln = stacked([H], Constant(1.0), (None,))
+        self.gate_w = stacked([H, c.intermediate_size], init, (None, "mp"))
+        self.up_w = stacked([H, c.intermediate_size], init, (None, "mp"))
+        self.down_w = stacked([c.intermediate_size, H], out_init,
+                              ("mp", None))
+
+        self.embed_tokens = Embedding(c.vocab_size, H, weight_attr=init)
+        self.embed_tokens.weight.dist_spec = ("mp", None)
+        self.norm = RMSNorm(H, epsilon=c.rms_norm_eps)
+        if c.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(H, c.vocab_size, bias_attr=False,
+                                  weight_attr=init)
+            self.lm_head.weight.dist_spec = (None, "mp")
+        rope = _rope_cos_sin(c.max_position_embeddings, hd, c.rope_theta)
+        self.register_buffer("rope_cos", Tensor(np.cos(rope)),
+                             persistable=False)
+        self.register_buffer("rope_sin", Tensor(np.sin(rope)),
+                             persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        c = self.config
+        b, s = input_ids.shape
+        x = self.embed_tokens(input_ids)
+        cos = self.rope_cos[:s]
+        sin = self.rope_sin[:s]
+        x = apply_op(
+            _llama_pipe_raw,
+            [self.input_ln, self.q_w, self.k_w, self.v_w, self.o_w,
+             self.post_ln, self.gate_w, self.up_w, self.down_w],
+            x, cos, sin,
+            n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,
+            head_dim=self.head_dim, eps=c.rms_norm_eps,
+            num_stages=None, n_micro=self.n_microbatches)
+        x = self.norm(x)
+        if labels is not None and c.fuse_linear_cross_entropy:
+            if self.lm_head is None:
+                return F.fused_linear_cross_entropy(
+                    x, self.embed_tokens.weight, labels,
+                    transpose_weight=True)
+            return F.fused_linear_cross_entropy(
+                x, self.lm_head.weight, labels)
+        if self.lm_head is None:
+            logits = P.matmul(x, self.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if labels is not None:
+            return LlamaPretrainingCriterion()(logits, labels)
+        return logits
 
 
 class LlamaPretrainingCriterion(Layer):

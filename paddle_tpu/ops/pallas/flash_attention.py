@@ -31,7 +31,7 @@ _NEG_INF = float(-1e30)
 _LANES = 128  # m/l scratch broadcast across one lane tile
 
 
-def _pick_blocks(sq: int, sk: int, d: int):
+def _pick_blocks(sq: int, sk: int):
     bq = min(512, sq)
     bk = min(512, sk)
     while sq % bq:
@@ -329,7 +329,7 @@ def flash_attention_raw(q, k, v, causal: bool = False):
         raise NotImplementedError("causal flash kernel needs sq == sk")
     if d not in (64, 128, 256) or h % hk or sq % 8 or sk % 8:
         raise NotImplementedError("flash kernel shape constraints")
-    bq, bk = _pick_blocks(sq, sk, d)
+    bq, bk = _pick_blocks(sq, sk)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
