@@ -29,6 +29,7 @@ from . import amp
 from . import io
 from . import jit
 from . import models
+from . import incubate
 from .framework import io as _framework_io
 from .framework.io import load, save
 

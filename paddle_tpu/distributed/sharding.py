@@ -46,7 +46,23 @@ def plan_param_spec(param, mesh: Mesh, stage: int,
     base = list(getattr(param, "dist_spec", None) or
                 (None,) * param.ndim)
     base += [None] * (param.ndim - len(base))
-    if stage >= 3 and _axis_size(mesh, fsdp_axis) > 1:
+    # drop annotated axes the dim cannot divide over (e.g. 4 experts on
+    # an 8-wide ep fold) — replicate instead of failing at device_put
+    for i, entry in enumerate(base):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        keep = []
+        size = 1
+        for a in axes:
+            a_sz = _axis_size(mesh, a)
+            if param.shape[i] % (size * a_sz) == 0:
+                keep.append(a)
+                size *= a_sz
+        base[i] = tuple(keep) if len(keep) > 1 else (
+            keep[0] if keep else None)
+    if stage >= 3 and _axis_size(mesh, fsdp_axis) > 1 \
+            and fsdp_axis not in jax.tree_util.tree_leaves(base):
         shape = tuple(param.shape)
         dim = _shardable_dim(shape, _axis_size(mesh, fsdp_axis), tuple(base))
         if dim is not None:

@@ -1,0 +1,2 @@
+"""paddle.incubate namespace parity (MoE et al., SURVEY.md §1 L7)."""
+from . import distributed  # noqa: F401

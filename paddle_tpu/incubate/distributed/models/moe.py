@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed.models.moe parity surface."""
+from ....nn.moe import ExpertFFN, MoELayer, TopKGate  # noqa: F401
+
+__all__ = ["MoELayer", "TopKGate", "ExpertFFN"]

@@ -1,4 +1,7 @@
 from . import gpt
 from . import llama
+from . import qwen2_moe
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion
-from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe,
+                    LlamaModel, LlamaPretrainingCriterion)
+from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM

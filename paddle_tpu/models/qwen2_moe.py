@@ -1,0 +1,148 @@
+"""Qwen2-MoE / DeepSeekMoE-style model (config #5 of BASELINE.json).
+
+Reference parity: PaddleNLP qwen2_moe modeling recipe on top of
+paddle.incubate moe (SURVEY.md §2.3 EP row): Llama-style attention +
+MoE FFN with shared expert, router aux load-balance loss summed into the
+training loss.
+
+TPU-native design: reuses the Llama attention/norm blocks; the MoE FFN
+is the GShard dense-dispatch MoELayer (nn/moe.py) whose expert weights
+shard over the (dp, sharding) EP fold — GSPMD emits the all-to-alls.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import ops as P
+from ..nn import functional as F
+from ..nn.common import Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.moe import MoELayer
+from ..nn.norm import RMSNorm
+from ..tensor import Tensor
+from .llama import (LlamaAttention, LlamaConfig, LlamaPretrainingCriterion,
+                    _rope_cos_sin)
+
+__all__ = ["Qwen2MoeConfig", "Qwen2MoeForCausalLM", "qwen2_moe_tiny_config"]
+
+
+@dataclass
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.001
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    fuse_linear_cross_entropy: bool = True
+    recompute: bool = False
+    sequence_parallel: bool = False
+    tie_word_embeddings: bool = False
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.moe_intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range,
+            use_flash_attention=self.use_flash_attention)
+
+
+def qwen2_moe_tiny_config() -> Qwen2MoeConfig:
+    return Qwen2MoeConfig(vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, moe_intermediate_size=32,
+                          shared_expert_intermediate_size=64,
+                          num_experts=8, num_experts_per_tok=2,
+                          max_position_embeddings=128, rope_theta=10000.0)
+
+
+class Qwen2MoeDecoderLayer(Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        c = config
+        self.input_layernorm = RMSNorm(c.hidden_size,
+                                       epsilon=c.rms_norm_eps)
+        self.self_attn = LlamaAttention(c.as_llama())
+        self.post_attention_layernorm = RMSNorm(c.hidden_size,
+                                                epsilon=c.rms_norm_eps)
+        self.mlp = MoELayer(
+            c.hidden_size, c.num_experts, c.moe_intermediate_size,
+            k=c.num_experts_per_tok, capacity_factor=c.capacity_factor,
+            shared_expert_intermediate=c.shared_expert_intermediate_size,
+            balance_loss_weight=1.0,  # scaled by aux coef at model level
+            init_std=c.initializer_range,
+            num_layers_scale=c.num_hidden_layers)
+
+    def forward(self, x, cos_sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos_sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        # aux returned explicitly so it survives recompute regions
+        return x, self.mlp.aux_loss
+
+
+class Qwen2MoeForCausalLM(Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embed_tokens = Embedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=Normal(0.0, c.initializer_range))
+        self.embed_tokens.weight.dist_spec = ("mp", None)
+        self.layers = LayerList([Qwen2MoeDecoderLayer(c)
+                                 for _ in range(c.num_hidden_layers)])
+        self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps)
+        self.lm_head = Linear(c.hidden_size, c.vocab_size, bias_attr=False,
+                              weight_attr=Normal(0.0, c.initializer_range))
+        self.lm_head.weight.dist_spec = (None, "mp")
+        hd = c.hidden_size // c.num_attention_heads
+        rope = _rope_cos_sin(c.max_position_embeddings, hd, c.rope_theta)
+        self.register_buffer("rope_cos", Tensor(np.cos(rope)),
+                             persistable=False)
+        self.register_buffer("rope_sin", Tensor(np.sin(rope)),
+                             persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        c = self.config
+        b, s = input_ids.shape
+        x = self.embed_tokens(input_ids)
+        cos_sin = (self.rope_cos[:s], self.rope_sin[:s])
+        aux_losses = []
+        for layer in self.layers:
+            if c.recompute:
+                from ..jit.recompute import recompute
+                x, aux = recompute(layer, x, cos_sin)
+            else:
+                x, aux = layer(x, cos_sin)
+            aux_losses.append(aux)
+        x = self.norm(x)
+        if labels is not None:
+            if c.fuse_linear_cross_entropy:
+                loss = F.fused_linear_cross_entropy(
+                    x, self.lm_head.weight, labels)
+            else:
+                loss = LlamaPretrainingCriterion()(self.lm_head(x), labels)
+            aux = aux_losses[0]
+            for a in aux_losses[1:]:
+                aux = aux + a
+            return loss + c.router_aux_loss_coef * aux
+        return self.lm_head(x)
