@@ -1,6 +1,7 @@
 """paddle.distributed surface: fleet, collectives, auto-parallel, sharding."""
 from . import env
 from . import auto_parallel
+from . import checkpoint
 from . import collective
 from . import fleet as _fleet_mod
 from . import parallel_layers

@@ -117,6 +117,46 @@ class CompiledTrainStep:
         self._key, sub = jax.random.split(self._key)
         return fn(self.state["params"], _to_arrays(batch), sub)
 
+    # -- checkpoint/resume ---------------------------------------------------
+    def _ckpt_tree(self):
+        """The resumable state: params+opt, RNG stream, LR-sched position.
+        One definition shared by save and load so the trees can't drift."""
+        return {"state": self.state,
+                "rng_key": jax.random.key_data(self._key)}
+
+    def save_checkpoint(self, path: str, async_save: bool = False):
+        """Sharded checkpoint of the full training state (params, optimizer
+        state, RNG stream, LR-scheduler position) — resumable on any mesh
+        via distributed.checkpoint's reshard-on-load."""
+        import json
+        from ..distributed import checkpoint as dck
+        sched = self.optimizer._lr_scheduler
+        tree = self._ckpt_tree()
+        # one JSON literal: scheduler state may hold lists (milestones,
+        # boundaries) which must not be key-flattened into the manifest
+        tree["lr_sched"] = json.dumps(sched.state_dict()) \
+            if sched is not None else None
+        return dck.save_state_dict(tree, path, async_save=async_save)
+
+    def load_checkpoint(self, path: str):
+        """Restore from ``save_checkpoint`` output.  The current state tree
+        (including its shardings — possibly on a different mesh than the
+        checkpoint was written from) is the template.  Scheduler state is
+        restored only when both sides have a scheduler, so resuming a
+        scheduled run with a constant LR (or vice versa) still restores
+        params/opt/RNG."""
+        import json
+        from ..distributed import checkpoint as dck
+        meta = dck.get_checkpoint_metadata(path)
+        tree = self._ckpt_tree()
+        dck.load_state_dict(tree, path, metadata=meta)
+        self.state = tree["state"]
+        self._key = jax.random.wrap_key_data(tree["rng_key"])
+        sched = self.optimizer._lr_scheduler
+        saved = meta["literals"].get("lr_sched")
+        if sched is not None and saved:
+            sched.set_state_dict(json.loads(saved))
+
     # -- state sync with the eager model ------------------------------------
     def sync_to_model(self):
         """Write compiled-state params back into the Layer (for eager use,

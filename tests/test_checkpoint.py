@@ -1,0 +1,250 @@
+"""Distributed sharded checkpoint tests (SURVEY.md §5 checkpoint/resume).
+
+The key contract (reference: python/paddle/distributed/checkpoint/):
+per-shard files + global metadata, and load-time RESHARDING — a state
+saved from one mesh loads onto a different mesh (or a single device)
+and training resumes with matching losses.
+"""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                               save_state_dict)
+from paddle_tpu.distributed.trainer import ShardedTrainStep
+from paddle_tpu.jit.train import CompiledTrainStep
+from paddle_tpu.models.gpt import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt2_tiny_config)
+
+
+@pytest.fixture(autouse=True)
+def reset_fleet():
+    yield
+    from paddle_tpu.distributed import fleet as fleet_mod
+    fleet_mod._HCG = None
+    fleet_mod._STRATEGY = None
+    from paddle_tpu.distributed import collective as coll
+    coll._DEFAULT_GROUP = None
+    import paddle_tpu.distributed.auto_parallel as ap
+    ap._GLOBAL_MESH = None
+
+
+def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                        "sharding_degree": sharding, "sep_degree": sep}
+    return s
+
+
+class TestRoundTrip:
+    def test_mixed_tree(self, tmp_path):
+        state = {"w": paddle.to_tensor(np.arange(12., dtype=np.float32)
+                                       .reshape(3, 4)),
+                 "nested": {"b": np.ones(5, np.float32), "step": 7,
+                            "name": "adamw", "none": None},
+                 "lst": [np.float32(2.5), np.zeros((2, 2))]}
+        save_state_dict(state, str(tmp_path / "ck"))
+        tmpl = {"w": paddle.to_tensor(np.zeros((3, 4), np.float32)),
+                "nested": {"b": np.zeros(5, np.float32), "step": 0,
+                           "name": "", "none": "x"},
+                "lst": [np.float32(0), np.ones((2, 2))]}
+        load_state_dict(tmpl, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(tmpl["w"].numpy(),
+                                      state["w"].numpy())
+        np.testing.assert_array_equal(np.asarray(tmpl["nested"]["b"]),
+                                      np.ones(5))
+        assert tmpl["nested"]["step"] == 7
+        assert tmpl["nested"]["name"] == "adamw"
+        assert tmpl["nested"]["none"] is None
+        np.testing.assert_array_equal(np.asarray(tmpl["lst"][0]), 2.5)
+        np.testing.assert_array_equal(np.asarray(tmpl["lst"][1]),
+                                      np.zeros((2, 2)))
+
+    def test_missing_key_raises(self, tmp_path):
+        save_state_dict({"a": np.zeros(3)}, str(tmp_path / "ck"))
+        with pytest.raises(Exception):
+            load_state_dict({"zzz": np.zeros(3)}, str(tmp_path / "ck"))
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        x = jax.numpy.arange(8, dtype=jax.numpy.bfloat16)
+        save_state_dict({"x": x}, str(tmp_path / "ck"))
+        out = load_state_dict({"x": jax.numpy.zeros(8, jax.numpy.bfloat16)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"], np.float32),
+                                      np.arange(8, dtype=np.float32))
+
+
+class TestReshardOnLoad:
+    def test_sharded_save_load_other_mesh(self, tmp_path):
+        hcg = fleet.init(strategy=make_strategy(dp=2, mp=4))
+        mesh = hcg.mesh
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        xs = jax.device_put(x, NamedSharding(
+            mesh, PartitionSpec(("dp",), ("mp",))))
+        save_state_dict({"x": xs}, str(tmp_path / "ck"))
+        # metadata records 8 unique chunks (2x4 grid)
+        from paddle_tpu.distributed.checkpoint import get_checkpoint_metadata
+        meta = get_checkpoint_metadata(str(tmp_path / "ck"))
+        assert len(meta["arrays"]["x"]["chunks"]) == 8
+
+        # reload onto a different layout: shard only dim 0 over 8
+        mesh2 = jax.sharding.Mesh(np.array(jax.devices()).reshape(8), ("a",))
+        tmpl = jax.device_put(np.zeros((8, 8), np.float32),
+                              NamedSharding(mesh2, PartitionSpec("a")))
+        out = load_state_dict({"x": tmpl}, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), x)
+        assert out["x"].sharding.spec == PartitionSpec("a")
+
+        # and onto a single device (fully replicated template)
+        tmpl1 = jax.device_put(np.zeros((8, 8), np.float32),
+                               jax.devices()[0])
+        out1 = load_state_dict({"x": tmpl1}, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out1["x"]), x)
+
+    def test_replicated_axes_stored_once(self, tmp_path):
+        hcg = fleet.init(strategy=make_strategy(dp=2, mp=4))
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        xs = jax.device_put(x, NamedSharding(hcg.mesh,
+                                             PartitionSpec(("mp",), None)))
+        save_state_dict({"x": xs}, str(tmp_path / "ck"))
+        from paddle_tpu.distributed.checkpoint import get_checkpoint_metadata
+        meta = get_checkpoint_metadata(str(tmp_path / "ck"))
+        # dp-replicated: only the 4 mp shards hit disk
+        assert len(meta["arrays"]["x"]["chunks"]) == 4
+        import os
+        files = [f for f in os.listdir(tmp_path / "ck") if f.endswith(".npy")]
+        assert len(files) == 4
+
+
+def _batches(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        ids = ((np.arange(32)[None, :] + rng.integers(0, 8, (8, 1))) % 32
+               ).astype(np.int32)
+        out.append({"x": ids[:, :-1], "y": ids[:, 1:].astype(np.int64)})
+    return out
+
+
+def _make_sharded_step(stage=2):
+    cfg = gpt2_tiny_config()
+    paddle.seed(42)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01,
+                          grad_clip=paddle.ClipGradByGlobalNorm(1.0))
+    return ShardedTrainStep(model, lambda m, b: crit(m(b["x"]), b["y"]), opt,
+                            stage=stage, seed=0)
+
+
+class TestTrainResume:
+    """VERDICT item 1 acceptance: train 3 steps on (dp2,sharding2,mp2),
+    save, reload onto (dp4,mp2) and onto 1 device; losses match the
+    no-restart run."""
+
+    def test_resume_same_and_other_mesh(self, tmp_path):
+        batches = _batches(6)
+
+        # uninterrupted run on (dp2, sharding2, mp2)
+        fleet.init(strategy=make_strategy(dp=2, sharding=2, mp=2))
+        step = _make_sharded_step()
+        ref = [float(step(b)) for b in batches]
+
+        # interrupted run on the same mesh: 3 steps, save, fresh, resume
+        from paddle_tpu.distributed import fleet as fleet_mod
+        fleet_mod._HCG = None
+        fleet_mod._STRATEGY = None
+        fleet.init(strategy=make_strategy(dp=2, sharding=2, mp=2))
+        step_a = _make_sharded_step()
+        for b in batches[:3]:
+            step_a(b)
+        step_a.save_checkpoint(str(tmp_path / "ck"))
+
+        fleet_mod._HCG = None
+        fleet_mod._STRATEGY = None
+        fleet.init(strategy=make_strategy(dp=2, sharding=2, mp=2))
+        paddle.seed(7)  # different init — must be overwritten by the load
+        step_b = _make_sharded_step()
+        step_b.load_checkpoint(str(tmp_path / "ck"))
+        resumed = [float(step_b(b)) for b in batches[3:]]
+        np.testing.assert_allclose(resumed, ref[3:], rtol=1e-6, atol=1e-6)
+
+        # resume onto a DIFFERENT mesh (dp4, mp2): reshard-on-load
+        fleet_mod._HCG = None
+        fleet_mod._STRATEGY = None
+        fleet.init(strategy=make_strategy(dp=4, mp=2))
+        step_c = _make_sharded_step(stage=1)
+        step_c.load_checkpoint(str(tmp_path / "ck"))
+        resumed_c = [float(step_c(b)) for b in batches[3:]]
+        np.testing.assert_allclose(resumed_c, ref[3:], rtol=2e-3, atol=2e-3)
+
+        # resume onto ONE device (plain CompiledTrainStep, no mesh)
+        fleet_mod._HCG = None
+        fleet_mod._STRATEGY = None
+        cfg = gpt2_tiny_config()
+        paddle.seed(3)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01,
+                              grad_clip=paddle.ClipGradByGlobalNorm(1.0))
+        step_d = CompiledTrainStep(
+            model, lambda m, b: crit(m(b["x"]), b["y"]), opt, seed=0)
+        step_d.load_checkpoint(str(tmp_path / "ck"))
+        resumed_d = [float(step_d(b)) for b in batches[3:]]
+        np.testing.assert_allclose(resumed_d, ref[3:], rtol=2e-3, atol=2e-3)
+
+    def test_scheduler_mismatch_resume(self, tmp_path):
+        """Saved-with-scheduler → resumed-with-constant-lr (and reverse)
+        must restore params/opt/RNG and skip the scheduler gracefully."""
+        fleet.init(strategy=make_strategy(dp=2, mp=2))
+        cfg = gpt2_tiny_config()
+
+        def make(lr):
+            paddle.seed(1)
+            model = GPTForCausalLM(cfg)
+            crit = GPTPretrainingCriterion()
+            opt = optimizer.AdamW(learning_rate=lr, weight_decay=0.01)
+            return ShardedTrainStep(
+                model, lambda m, b: crit(m(b["x"]), b["y"]), opt,
+                stage=1, seed=0)
+
+        from paddle_tpu.optimizer import lr as lr_mod
+        # list-valued scheduler state (milestones) must round-trip whole
+        sched = lr_mod.MultiStepDecay(learning_rate=1e-3, milestones=[2, 4])
+        step_s = make(sched)
+        step_s(_batches(1)[0])
+        step_s.save_checkpoint(str(tmp_path / "cks"))
+        step_c = make(1e-3)
+        step_c.load_checkpoint(str(tmp_path / "cks"))  # no raise
+        step_c(_batches(1)[0])
+
+        sched_r = lr_mod.MultiStepDecay(learning_rate=1e-3, milestones=[2, 4])
+        step_r = make(sched_r)
+        step_r.load_checkpoint(str(tmp_path / "cks"))
+        assert sched_r.last_epoch == sched.last_epoch
+        assert list(sched_r.milestones) == [2, 4]
+        step_r(_batches(1)[0])
+        step_r.save_checkpoint(str(tmp_path / "cks2"))  # second save works
+
+        step_c2 = make(1e-3)
+        step_c2(_batches(1)[0])
+        step_c2.save_checkpoint(str(tmp_path / "ckc"))
+        sched2 = lr_mod.StepDecay(learning_rate=1e-3, step_size=2)
+        step_s2 = make(sched2)
+        step_s2.load_checkpoint(str(tmp_path / "ckc"))  # no raise
+        step_s2(_batches(1)[0])
+
+    def test_async_save(self, tmp_path):
+        fleet.init(strategy=make_strategy(dp=2, mp=2))
+        step = _make_sharded_step(stage=1)
+        step(_batches(1)[0])
+        t = step.save_checkpoint(str(tmp_path / "ck"), async_save=True)
+        assert t is not None
+        t.join(timeout=60)
+        step2 = _make_sharded_step(stage=1)
+        step2.load_checkpoint(str(tmp_path / "ck"))
