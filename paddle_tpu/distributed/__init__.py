@@ -3,6 +3,7 @@ from . import env
 from . import auto_parallel
 from . import checkpoint
 from . import collective
+from . import context_parallel
 from . import fleet as _fleet_mod
 from . import parallel_layers
 from . import sharding

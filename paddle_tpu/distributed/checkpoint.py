@@ -27,6 +27,7 @@ import json
 import os
 import re
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -177,20 +178,40 @@ def save_state_dict(state_dict, path: str, process_group=None,
             writes.append((os.path.join(path, _fname(key, box)),
                            np.asarray(shard.data)))
 
+    nproc = jax.process_count()
+    pidx = jax.process_index()
+
     def flush():
         for fpath, arr in writes:
             np.save(fpath, arr, allow_pickle=False)
         # the manifest is the commit point: written only after every chunk
         # is flushed, via tmp+rename so readers never see a manifest that
-        # references missing/truncated chunk files
-        if jax.process_count() > 1:  # all hosts' chunks on disk first
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("ckpt_flush")
-        if jax.process_index() == coordinator_rank:
+        # references missing/truncated chunk files.  Multi-host sync uses
+        # per-process marker files on the (shared) checkpoint dir — NOT a
+        # device collective, which on a background thread could interleave
+        # with the main thread's training collectives and deadlock.
+        if nproc > 1:
+            with open(os.path.join(path, f".proc{pidx}.done"), "w"):
+                pass
+        if pidx == coordinator_rank:
+            if nproc > 1:
+                deadline = time.monotonic() + 600.0
+                want = [os.path.join(path, f".proc{i}.done")
+                        for i in range(nproc)]
+                while not all(os.path.exists(w) for w in want):
+                    enforce(time.monotonic() < deadline,
+                            "timed out waiting for other hosts' shards")
+                    time.sleep(0.2)
             tmp = os.path.join(path, _METADATA + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1)
             os.replace(tmp, os.path.join(path, _METADATA))
+            if nproc > 1:
+                for i in range(nproc):
+                    try:
+                        os.remove(os.path.join(path, f".proc{i}.done"))
+                    except OSError:
+                        pass
 
     if async_save:
         t = threading.Thread(target=flush, daemon=False)
@@ -271,22 +292,25 @@ def load_state_dict(state_dict, path: str, process_group=None,
         enforce(entry is not None, f"{key!r} not found in checkpoint {path}")
         shape = tuple(entry["global_shape"])
         dtype = np.dtype(entry["dtype"])
+        # materialize in the TEMPLATE's dtype: a bf16 train state restored
+        # from an f32 checkpoint (or vice versa) must keep its configured
+        # precision rather than silently adopting the checkpoint's
+        tmpl_arr = leaf.value if isinstance(leaf, Tensor) else leaf
+        out_dtype = tmpl_arr.dtype if isinstance(
+            tmpl_arr, (jax.Array, np.ndarray)) else dtype
         sharding = _target_sharding(leaf)
         if sharding is None:
             arr = jax.numpy.asarray(
                 _read_box(path, entry, (slice(None),) * len(shape), shape,
-                          dtype))
+                          dtype).astype(out_dtype))
         else:
-            tshape = tuple(leaf.shape if not isinstance(leaf, Tensor)
-                           else leaf.value.shape)
-            enforce(tshape == shape,
-                    f"{key!r}: template shape {tshape} != checkpoint "
-                    f"global shape {shape}")
+            enforce(tuple(tmpl_arr.shape) == shape,
+                    f"{key!r}: template shape {tuple(tmpl_arr.shape)} != "
+                    f"checkpoint global shape {shape}")
             arr = jax.make_array_from_callback(
                 shape, sharding,
-                lambda idx, e=entry: _read_box(path, e, idx, shape, dtype))
-            if arr.dtype != np.dtype(dtype):  # pragma: no cover
-                arr = arr.astype(dtype)
+                lambda idx, e=entry: _read_box(path, e, idx, shape,
+                                               dtype).astype(out_dtype))
         new_flat[key] = arr
 
     for key, val in new_flat.items():

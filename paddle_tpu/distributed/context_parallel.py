@@ -1,0 +1,388 @@
+"""Context parallelism: ring attention + Ulysses over the ``sep`` axis.
+
+Reference parity: the reference's sequence/context-parallel stack —
+fleet/base/topology.py ``sep`` comm group + communication/all_to_all
+(Ulysses head<->seq reshard) and the PaddleNLP ring-flash-attention
+recipes built on them (SURVEY.md §2.3 sep row, §5 long-context).
+
+TPU-native design (both behind one ``sep_degree`` knob):
+
+* **Ring attention** — inside ``shard_map`` manual over ``sep``, each
+  device keeps its Q chunk resident and streams K/V chunks around the
+  ring with ``lax.ppermute`` over ICI, merging per-chunk partial
+  attention with the online-softmax (logsumexp) rule.  The ring is a
+  *static* python loop (sep is a mesh constant), so each hop is one
+  ppermute + one chunk-attention kernel; causally-dead hops are skipped
+  per-device with ``lax.cond``.  Backward re-runs the ring with the
+  saved global logsumexp: dK/dV accumulators travel WITH their K/V
+  chunks and arrive home after a full cycle (the FlashAttention-2
+  backward split generalized across devices).
+* **Ulysses** — two ``lax.all_to_all``s reshard [B, S/n, H, D] ->
+  [B, S, H/n, D]; full-sequence flash attention runs locally per head
+  group, then the inverse all_to_all restores the seq-sharded layout.
+  Differentiable end-to-end (all_to_all transposes to itself).
+
+Chunk/local attention uses the Pallas flash kernel on TPU (forward
+normalized-out + logsumexp) and a jnp oracle elsewhere — the merge and
+ring logic are identical, so the CPU parity tests cover the TPU path's
+structure.  Ring requires seq % sep == 0; Ulysses additionally needs
+heads (incl. KV heads) % sep == 0 — ``sep_attention_raw`` picks
+automatically (FLAGS_sep_impl overrides: ring | ulysses | auto).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..common.flags import define_flag, get_flag
+
+__all__ = ["ring_attention_local", "ulysses_attention_local",
+           "sep_attention_raw"]
+
+define_flag("sep_impl", "auto",
+            "context-parallel attention impl: auto | ring | ulysses")
+
+_NEG_INF = float(-jnp.inf)
+
+
+def _use_flash() -> bool:
+    from ..runtime.device import is_compiled_with_tpu
+    return bool(get_flag("use_pallas")) and is_compiled_with_tpu()
+
+
+def _flash_eligible(lq: int, lk: int, h: int, hk: int, d: int,
+                    causal: bool) -> bool:
+    if causal and lq != lk:
+        return False
+    return d in (64, 128, 256) and h % hk == 0 and lq % 8 == 0 \
+        and lk % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# chunk attention: normalized out + logsumexp (flash on TPU, jnp oracle)
+# ---------------------------------------------------------------------------
+
+def _chunk_attn_jnp(q, k, v, causal: bool, q_off, k_off
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """q [b,lq,h,d], k/v [b,lk,hk,d] -> (o [b,lq,h,d] f32 normalized,
+    lse [b,h,lq] f32).  Offsets give global positions for causal masking
+    (traced scalars are fine).  Fully-masked rows get o=0, lse=-inf."""
+    b, lq, h, d = q.shape
+    lk, hk = k.shape[1], k.shape[2]
+    if hk != h:
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(lq)
+        kpos = k_off + jnp.arange(lk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [b,h,lq]
+    msafe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - msafe[..., None])                         # [b,h,lq,lk]
+    if causal:
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+    l = jnp.sum(p, axis=-1)                                   # [b,h,lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)   # [b,lq,h,d]
+    lse = jnp.where(l > 0, msafe + jnp.log(jnp.maximum(l, 1e-30)),
+                    _NEG_INF)
+    return o, lse
+
+
+def _chunk_attn(q, k, v, causal: bool, q_off, k_off):
+    """Dispatch: Pallas flash (TPU, static-eligible shapes) or jnp.
+    The flash kernel path is only taken for offset patterns it encodes
+    exactly: full (non-causal) chunks, or the diagonal chunk where
+    q_off == k_off statically (ring step 0)."""
+    b, lq, h, d = q.shape
+    lk, hk = k.shape[1], k.shape[2]
+    static_diag = (q_off is k_off)  # same traced value object => diagonal
+    if _use_flash() and _flash_eligible(lq, lk, h, hk, d,
+                                        causal and static_diag):
+        if not causal or static_diag:
+            from ..ops.pallas.flash_attention import _fwd, _pick_blocks
+            bq, bk = _pick_blocks(lq, lk)
+            o, lse = _fwd(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                          jnp.swapaxes(v, 1, 2),
+                          causal=causal, bq=bq, bk=bk)
+            return (jnp.swapaxes(o, 1, 2).astype(jnp.float32),
+                    lse[..., 0])
+    return _chunk_attn_jnp(q, k, v, causal, q_off, k_off)
+
+
+def _merge(out, lse, o_i, lse_i):
+    """Online-softmax merge of two normalized partials."""
+    new_lse = jnp.logaddexp(lse, lse_i)
+    w_prev = jnp.where(jnp.isneginf(new_lse), 0.0,
+                       jnp.exp(lse - new_lse))
+    w_new = jnp.where(jnp.isneginf(new_lse), 0.0,
+                      jnp.exp(lse_i - new_lse))
+    out = out * w_prev[..., None].swapaxes(1, 2) \
+        + o_i * w_new[..., None].swapaxes(1, 2)
+    return out, new_lse
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _rotate(tree, axis_name: str, n: int):
+    perm = _ring_perm(n)
+    return jax.tree_util.tree_map(
+        lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (manual over `axis_name`), ring-level custom vjp
+# ---------------------------------------------------------------------------
+
+def _ring_fwd_impl(q, k, v, axis_name: str, causal: bool):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    q_off = idx * lq
+    out = jnp.zeros((b, lq, h, d), jnp.float32)
+    lse = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    k_cur, v_cur = k, v
+    for r in range(n):
+        # chunk j = (idx - r) mod n is visiting; causal skips j > idx
+        j = (idx - r) % n
+        k_off = j * lk
+        if r == 0:
+            o_i, lse_i = _chunk_attn(q, k_cur, v_cur, causal, q_off, q_off)
+            out, lse = _merge(out, lse, o_i, lse_i)
+        else:
+            def compute(args, k_off=k_off):
+                kc, vc = args
+                o_i, lse_i = _chunk_attn(q, kc, vc, False, q_off, k_off)
+                return _merge(out, lse, o_i, lse_i)
+
+            def skip(args):
+                return out, lse
+
+            if causal:
+                out, lse = lax.cond(idx >= r, compute, skip, (k_cur, v_cur))
+            else:
+                out, lse = compute((k_cur, v_cur))
+        if r != n - 1:
+            k_cur, v_cur = _rotate((k_cur, v_cur), axis_name, n)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
+    """Local-chunk ring attention; call inside shard_map manual over
+    ``axis_name``.  q [b, s/n, h, d]; k/v [b, s/n, hk, d] (GQA ok)."""
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, res, do):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk, hk = k.shape[1], k.shape[2]
+    group = h // hk
+    scale = 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # delta_i = rowsum(dO * O)  [b,h,lq]
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, out.astype(jnp.float32))
+    q_off = idx * lq
+
+    def repeat_kv(x):
+        return jnp.repeat(x, group, axis=2) if group > 1 else x
+
+    def chunk_grads(kc, vc, k_off):
+        kcf = repeat_kv(kc.astype(jnp.float32))
+        vcf = repeat_kv(vc.astype(jnp.float32))
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kcf,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_off + jnp.arange(lq)
+            kpos = k_off + jnp.arange(lk)
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+            s = jnp.where(mask, s, _NEG_INF)
+        # p from the saved GLOBAL lse (rows with lse=-inf have no mass)
+        lse_safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        p = jnp.exp(s - lse_safe[..., None])
+        p = jnp.where(jnp.isneginf(s) | jnp.isneginf(lse)[..., None],
+                      0.0, p)                                  # [b,h,q,k]
+        dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vcf)
+        ds = p * (dp - delta[..., None])
+        dq_i = jnp.einsum("bhqk,bkhd->bqhd", ds, kcf) * scale
+        dk_j = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        if group > 1:
+            dk_j = dk_j.reshape(b, lk, hk, group, d).sum(axis=3)
+            dv_j = dv_j.reshape(b, lk, hk, group, d).sum(axis=3)
+        return dq_i, dk_j, dv_j
+
+    dq = jnp.zeros((b, lq, h, d), jnp.float32)
+    dk_acc = jnp.zeros((b, lk, hk, d), jnp.float32)
+    dv_acc = jnp.zeros((b, lk, hk, d), jnp.float32)
+    k_cur, v_cur = k, v
+    for r in range(n):
+        j = (idx - r) % n
+        k_off = j * lk
+        if r == 0:
+            dq_i, dk_j, dv_j = chunk_grads(k_cur, v_cur, q_off)
+            dq = dq + dq_i
+            dk_acc = dk_acc + dk_j
+            dv_acc = dv_acc + dv_j
+        else:
+            def compute(args, k_off=k_off):
+                kc, vc, dka, dva = args
+                dq_i, dk_j, dv_j = chunk_grads(kc, vc, k_off)
+                return dq + dq_i, dka + dk_j, dva + dv_j
+
+            def skip(args):
+                _, _, dka, dva = args
+                return dq, dka, dva
+
+            if causal:
+                dq, dk_acc, dv_acc = lax.cond(
+                    idx >= r, compute, skip, (k_cur, v_cur, dk_acc, dv_acc))
+            else:
+                dq, dk_acc, dv_acc = compute((k_cur, v_cur, dk_acc, dv_acc))
+        # rotate K/V together with their traveling grad accumulators;
+        # after the final hop each chunk's (dk, dv) is back home.  The
+        # last hop ships only the accumulators — K/V are not consumed
+        # again, and they dominate the hop payload for long context.
+        if r != n - 1:
+            k_cur, v_cur, dk_acc, dv_acc = _rotate(
+                (k_cur, v_cur, dk_acc, dv_acc), axis_name, n)
+        else:
+            dk_acc, dv_acc = _rotate((dk_acc, dv_acc), axis_name, n)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+ring_attention_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all_to_all heads<->seq), AD-native
+# ---------------------------------------------------------------------------
+
+def _local_full_attention(q, k, v, causal: bool):
+    """Full-sequence attention on local arrays (flash on TPU, oracle
+    elsewhere) — used after the Ulysses reshard."""
+    b, s, h, d = q.shape
+    hk = k.shape[2]
+    if _use_flash() and _flash_eligible(s, k.shape[1], h, hk, d, causal):
+        from ..ops.pallas.flash_attention import flash_attention_raw
+        try:
+            return flash_attention_raw(q, k, v, causal=causal)
+        except NotImplementedError:
+            pass
+    from ..ops import _nn
+    return _nn.scaled_dot_product_attention(q, k, v, is_causal=causal)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = True):
+    """Ulysses context parallelism; call inside shard_map manual over
+    ``axis_name``.  q [b, s/n, h, d] with h % n == 0 (same for KV heads):
+    all_to_all to [b, s, h/n, d], attend, all_to_all back."""
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    o = _local_full_attention(qh, kh, vh, causal)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# global entry: shard_map wrapper over the hybrid mesh
+# ---------------------------------------------------------------------------
+
+def sep_attention_raw(q, k, v, causal: bool = True,
+                      impl: Optional[str] = None, mesh=None):
+    """Context-parallel attention on GLOBAL [B, S, H, D] arrays.
+
+    Wraps ring/ulysses in ``shard_map`` manual over (batch axes, sep,
+    mp-if-divisible); remaining mesh axes stay automatic.  Raises
+    NotImplementedError when no sep axis is active or shapes don't
+    divide — callers fall back to plain attention.
+    """
+    if mesh is None:
+        from .auto_parallel import get_mesh
+        pm = get_mesh()
+        mesh = pm.mesh if pm is not None else None
+    if mesh is None:
+        raise NotImplementedError("no mesh — sep attention inactive")
+    sep = mesh.shape.get("sep", 1)
+    if sep <= 1:
+        raise NotImplementedError("sep degree is 1")
+    b, s, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if s != sk:
+        raise NotImplementedError("sep attention needs sq == sk "
+                                  "(no KV-cache decode)")
+    if s % sep:
+        raise NotImplementedError(f"seq {s} not divisible by sep {sep}")
+
+    batch_axes = tuple(a for a in ("dp", "sharding")
+                       if mesh.shape.get(a, 1) > 1)
+    if batch_axes and b % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = ()
+    mp = mesh.shape.get("mp", 1)
+    use_mp = mp > 1 and h % mp == 0 and hk % mp == 0
+    h_loc = h // mp if use_mp else h
+    hk_loc = hk // mp if use_mp else hk
+
+    if impl is None:
+        impl = str(get_flag("sep_impl"))
+    if impl == "auto":
+        impl = "ulysses" if (h_loc % sep == 0 and hk_loc % sep == 0) \
+            else "ring"
+    if impl == "ulysses" and (h_loc % sep or hk_loc % sep):
+        raise NotImplementedError(
+            f"ulysses needs heads divisible by sep ({h_loc}/{hk_loc} "
+            f"vs {sep})")
+
+    manual = frozenset({"sep", *batch_axes,
+                        *({"mp"} if use_mp else set())})
+    bspec = batch_axes if batch_axes else None
+    hspec = "mp" if use_mp else None
+    spec = P(bspec, "sep", hspec, None)
+
+    return _mapped(mesh, impl, causal, manual, spec)(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _mapped(mesh, impl: str, causal: bool, manual: frozenset, spec):
+    fn = {"ring": ring_attention_local,
+          "ulysses": ulysses_attention_local}[impl]
+    body = functools.partial(fn, axis_name="sep", causal=causal)
+    mapped = jax.shard_map(
+        lambda q_, k_, v_: body(q_, k_, v_),
+        mesh=mesh, axis_names=manual,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    # partial-manual shard_map only lowers under jit; this wrapper inlines
+    # under an outer jit and makes eager calls (incl. jax.vjp tracing from
+    # the eager-autograd tape) work with one cached compile
+    return jax.jit(mapped)

@@ -22,12 +22,26 @@ from . import env as dist_env
 from .strategy import DistributedStrategy
 from .topology import HybridCommunicateGroup
 
-__all__ = ["init", "fleet", "DistributedStrategy", "distributed_model",
-           "distributed_optimizer", "get_hybrid_communicate_group",
+__all__ = ["init", "reset", "fleet", "DistributedStrategy",
+           "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group",
            "worker_num", "worker_index", "is_first_worker", "barrier_worker"]
 
 _HCG: Optional[HybridCommunicateGroup] = None
 _STRATEGY: Optional[DistributedStrategy] = None
+
+
+def reset():
+    """Tear down all fleet/mesh state so a new topology can be built —
+    the single owner of 'what constitutes mesh state' (drivers and tests
+    must use this instead of poking module globals)."""
+    global _HCG, _STRATEGY
+    _HCG = None
+    _STRATEGY = None
+    from . import auto_parallel as _ap
+    _ap._GLOBAL_MESH = None
+    from . import collective as _coll
+    _coll._DEFAULT_GROUP = None
 
 
 def init(role_maker=None, is_collective: bool = True,
@@ -98,6 +112,7 @@ def barrier_worker():
 class _FleetFacade:
     """``paddle.distributed.fleet`` object-style access (fleet.init, ...)"""
     init = staticmethod(init)
+    reset = staticmethod(reset)
     distributed_model = staticmethod(distributed_model)
     distributed_optimizer = staticmethod(distributed_optimizer)
     worker_num = staticmethod(worker_num)

@@ -57,6 +57,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     no dropout (the fast path used by the LLM recipes); falls back to the
     jnp reference otherwise.
     """
+    if attn_mask is None and dropout_p == 0.0:
+        # context parallelism: when the active mesh has a sep axis, route
+        # through ring/Ulysses attention (SURVEY.md §2.3 sep row)
+        from ..distributed.auto_parallel import get_mesh
+        pm = get_mesh()
+        if pm is not None and pm.mesh.shape.get("sep", 1) > 1:
+            from ..distributed.context_parallel import sep_attention_raw
+            try:
+                return apply_op(sep_attention_raw, query, key, value,
+                                causal=is_causal)
+            except NotImplementedError:
+                pass  # shape not sep-shardable; plain paths below
     use_pallas = (
         get_flag("use_pallas")
         and attn_mask is None
@@ -67,8 +79,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         kernel = _flash_kernel()
         if kernel is not None:
             try:
+                # NotImplementedError is the kernel's documented "shape not
+                # covered" signal; anything else is a real bug and must
+                # propagate (ADVICE.md round-1)
                 return apply_op(kernel, query, key, value, causal=is_causal)
-            except Exception:  # pragma: no cover — lowering unavailable
+            except NotImplementedError:
                 pass
     return _api.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,

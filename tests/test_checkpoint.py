@@ -25,13 +25,7 @@ from paddle_tpu.models.gpt import (GPTForCausalLM, GPTPretrainingCriterion,
 @pytest.fixture(autouse=True)
 def reset_fleet():
     yield
-    from paddle_tpu.distributed import fleet as fleet_mod
-    fleet_mod._HCG = None
-    fleet_mod._STRATEGY = None
-    from paddle_tpu.distributed import collective as coll
-    coll._DEFAULT_GROUP = None
-    import paddle_tpu.distributed.auto_parallel as ap
-    ap._GLOBAL_MESH = None
+    fleet.reset()
 
 
 def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
@@ -156,17 +150,14 @@ class TestTrainResume:
         ref = [float(step(b)) for b in batches]
 
         # interrupted run on the same mesh: 3 steps, save, fresh, resume
-        from paddle_tpu.distributed import fleet as fleet_mod
-        fleet_mod._HCG = None
-        fleet_mod._STRATEGY = None
+        fleet.reset()
         fleet.init(strategy=make_strategy(dp=2, sharding=2, mp=2))
         step_a = _make_sharded_step()
         for b in batches[:3]:
             step_a(b)
         step_a.save_checkpoint(str(tmp_path / "ck"))
 
-        fleet_mod._HCG = None
-        fleet_mod._STRATEGY = None
+        fleet.reset()
         fleet.init(strategy=make_strategy(dp=2, sharding=2, mp=2))
         paddle.seed(7)  # different init — must be overwritten by the load
         step_b = _make_sharded_step()
@@ -175,8 +166,7 @@ class TestTrainResume:
         np.testing.assert_allclose(resumed, ref[3:], rtol=1e-6, atol=1e-6)
 
         # resume onto a DIFFERENT mesh (dp4, mp2): reshard-on-load
-        fleet_mod._HCG = None
-        fleet_mod._STRATEGY = None
+        fleet.reset()
         fleet.init(strategy=make_strategy(dp=4, mp=2))
         step_c = _make_sharded_step(stage=1)
         step_c.load_checkpoint(str(tmp_path / "ck"))
@@ -184,8 +174,7 @@ class TestTrainResume:
         np.testing.assert_allclose(resumed_c, ref[3:], rtol=2e-3, atol=2e-3)
 
         # resume onto ONE device (plain CompiledTrainStep, no mesh)
-        fleet_mod._HCG = None
-        fleet_mod._STRATEGY = None
+        fleet.reset()
         cfg = gpt2_tiny_config()
         paddle.seed(3)
         model = GPTForCausalLM(cfg)
