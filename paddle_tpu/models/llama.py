@@ -25,6 +25,8 @@ from ..nn.common import Embedding, Linear
 from ..nn.container import LayerList
 from ..nn.initializer import Constant, Normal
 from ..nn.layer import Layer
+from ..nn.generation import (GenerationMixin, StaticCache,
+                             cached_attention_raw, write_cache_raw)
 from ..nn.norm import RMSNorm
 from ..tensor import Tensor, apply_op
 
@@ -137,18 +139,33 @@ class LlamaAttention(Layer):
         self.o_proj.weight.dist_spec = ("mp", None)
         self.use_flash = config.use_flash_attention
 
-    def forward(self, x, cos_sin, cache=None):
+    def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         b, s, _ = x.shape
         q = P.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = P.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = P.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         cos, sin = cos_sin
         q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        attn_fn = (F.scaled_dot_product_attention if self.use_flash
+                   else F.scaled_dot_product_attention_ref)
+        if pos is not None:
+            # static-cache decode protocol (nn/generation.py): fixed-size
+            # buffers, in-place writes — every step one compiled shape
+            if prefill and s > 1:
+                # caller guarantees pos == 0 (GenerationMixin's first
+                # call): attention is plain causal over the prompt, flash
+                # eligible; chunked prefill (pos>0) takes the generic path
+                out = attn_fn(q, k, v, is_causal=True)
+                kb, vb = apply_op(write_cache_raw, k, v, cache.k, cache.v,
+                                  pos)
+            else:
+                out, kb, vb = apply_op(cached_attention_raw, q, k, v,
+                                       cache.k, cache.v, pos)
+            out = P.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), StaticCache(kb, vb)
         if cache is not None:
             k = P.concat([cache[0], k], axis=1)
             v = P.concat([cache[1], v], axis=1)
-        attn_fn = (F.scaled_dot_product_attention if self.use_flash
-                   else F.scaled_dot_product_attention_ref)
         out = attn_fn(q, k, v, is_causal=True)
         out = P.reshape(out, [b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
@@ -188,10 +205,11 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cos_sin, cache=None):
+    def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         if cache is not None:
             attn, new_cache = self.self_attn(self.input_layernorm(x),
-                                             cos_sin, cache)
+                                             cos_sin, cache, pos=pos,
+                                             prefill=prefill)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
@@ -224,13 +242,29 @@ class LlamaModel(Layer):
         sin = self.rope_sin[start:start + seq_len]
         return cos, sin
 
-    def forward(self, input_ids, caches=None):
+    def _cos_sin_at(self, pos, seq_len: int):
+        """RoPE tables gathered at traced positions pos..pos+seq_len."""
+        def gather(cos_t, sin_t, p, *, s):
+            import jax.numpy as jnp
+            idx = p.astype(jnp.int32) + jnp.arange(s)
+            return jnp.take(cos_t, idx, axis=0), jnp.take(sin_t, idx, axis=0)
+        return apply_op(gather, self.rope_cos, self.rope_sin, pos, s=seq_len)
+
+    def forward(self, input_ids, caches=None, pos=None, prefill=False):
         b, s = input_ids.shape
-        past = 0 if caches is None else (
-            caches[0][0].shape[1] if caches[0] is not None else 0)
         x = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             x = apply_op(_seq_parallel_raw, x)
+        if pos is not None:
+            cos_sin = self._cos_sin_at(pos, s)
+            new_caches = []
+            for i, layer in enumerate(self.layers):
+                x, c = layer(x, cos_sin, caches[i], pos=pos,
+                             prefill=prefill)
+                new_caches.append(c)
+            return self.norm(x), new_caches
+        past = 0 if caches is None else (
+            caches[0][0].shape[1] if caches[0] is not None else 0)
         cos_sin = self._cos_sin(past, s)
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
@@ -248,7 +282,7 @@ class LlamaModel(Layer):
         return x
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -267,7 +301,17 @@ class LlamaForCausalLM(Layer):
     def model(self):
         return self.llama
 
-    def forward(self, input_ids, caches=None, labels=None):
+    def forward(self, input_ids, caches=None, labels=None, pos=None,
+                prefill=False):
+        if pos is not None:
+            hidden, new_caches = self.llama(input_ids, caches, pos=pos,
+                                            prefill=prefill)
+            if self.lm_head is None:
+                logits = P.matmul(hidden, self.llama.embed_tokens.weight,
+                                  transpose_y=True)
+            else:
+                logits = self.lm_head(hidden)
+            return logits, new_caches
         out = self.llama(input_ids, caches)
         hidden = out[0] if caches is not None else out
         if labels is not None and self.config.fuse_linear_cross_entropy:
@@ -298,6 +342,23 @@ class LlamaForCausalLM(Layer):
         return [(P.zeros([batch_size, 0, c.num_key_value_heads, hd]),
                  P.zeros([batch_size, 0, c.num_key_value_heads, hd]))
                 for _ in range(c.num_hidden_layers)]
+
+    def gen_static_caches(self, batch_size: int, total_len: int):
+        """Fixed-size decode buffers (GenerationMixin protocol)."""
+        from ..common.errors import enforce
+        c = self.config
+        enforce(total_len <= c.max_position_embeddings,
+                f"prompt + max_new_tokens = {total_len} exceeds "
+                f"max_position_embeddings = {c.max_position_embeddings} "
+                "(the RoPE table would clamp and rotations would be wrong)")
+        hd = c.hidden_size // c.num_attention_heads
+        dt = self.llama.embed_tokens.weight.dtype
+        return [StaticCache(
+            P.zeros([batch_size, total_len, c.num_key_value_heads, hd],
+                    dtype=dt),
+            P.zeros([batch_size, total_len, c.num_key_value_heads, hd],
+                    dtype=dt))
+            for _ in range(c.num_hidden_layers)]
 
 
 def _attn_for_shape(q, k, v):

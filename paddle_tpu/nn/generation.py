@@ -1,0 +1,238 @@
+"""Autoregressive decoding: StaticCache + jitted ``generate()``.
+
+Reference parity: PaddleNLP GenerationMixin (``model.generate`` with
+greedy_search / sampling strategies over KV caches) — the serving-side
+decode loop of SURVEY.md §1 L8 / §7 step 9.
+
+TPU-native design: the whole loop is ONE compiled XLA program.  KV
+caches are preallocated fixed-size buffers ([B, total_len, HK, D],
+written in place with ``lax.dynamic_update_slice``) so every decode
+step has identical static shapes — no per-step recompiles, no concat
+reallocation (the reference's dynamic-shape cache concat is a CUDA
+idiom that XLA would recompile on).  Prefill attends with the flash
+kernel (causal); decode steps are single-query cached attention
+(memory-bound; O(total_len) per step).  The token loop is a
+``lax.scan`` with an EOS done-mask, sampling via
+``jax.random.categorical`` with top-k/top-p filtering.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import enforce
+
+__all__ = ["StaticCache", "GenerationMixin", "sample_logits"]
+
+
+class StaticCache(NamedTuple):
+    """Fixed-size KV buffer for one attention layer: k/v [B, T, HK, D].
+    A NamedTuple so it is a jax pytree (scan-carry friendly)."""
+    k: Any
+    v: Any
+
+
+# ---------------------------------------------------------------------------
+# raw decode attention (single- or multi-query against a static buffer)
+# ---------------------------------------------------------------------------
+
+def cached_attention_raw(q, k_new, v_new, k_buf, v_buf, pos):
+    """Write k_new/v_new into the buffers at ``pos`` and attend q against
+    positions [0, pos + s).  q [B,S,H,D]; bufs [B,T,HK,D]; pos scalar.
+
+    Returns (out [B,S,H,D], k_buf', v_buf').  Valid for any S (prefill
+    uses S=prompt_len with pos=0; decode S=1)."""
+    b, s, h, d = q.shape
+    t, hk = k_buf.shape[1], k_buf.shape[2]
+    g = h // hk
+    pos = pos.astype(jnp.int32)
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k_new.astype(k_buf.dtype), (0, pos, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v_new.astype(v_buf.dtype), (0, pos, 0, 0))
+    # grouped einsum: KV buffers are read ONCE in their stored dtype
+    # (decode is HBM-bound — no f32 buffer copy, no GQA head repeat);
+    # the MXU accumulates in f32 via preferred_element_type
+    scale = 1.0 / math.sqrt(d)
+    qg = q.astype(k_buf.dtype).reshape(b, s, hk, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_buf,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = pos + jnp.arange(s)                    # [s]
+    k_pos = jnp.arange(t)                          # [t]
+    mask = k_pos[None, :] <= q_pos[:, None]        # causal + "written yet"
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)        # f32
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_buf,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, s, h, d).astype(q.dtype), k_buf, v_buf
+
+
+def write_cache_raw(k_new, v_new, k_buf, v_buf, pos):
+    """Prefill helper: just write the new K/V into the buffers (attention
+    itself already ran through the flash path)."""
+    pos = pos.astype(jnp.int32)
+    k_buf = jax.lax.dynamic_update_slice(
+        k_buf, k_new.astype(k_buf.dtype), (0, pos, 0, 0))
+    v_buf = jax.lax.dynamic_update_slice(
+        v_buf, v_new.astype(v_buf.dtype), (0, pos, 0, 0))
+    return k_buf, v_buf
+
+
+# ---------------------------------------------------------------------------
+# logits processing / sampling
+# ---------------------------------------------------------------------------
+
+def _top_k_filter(logits, k: int):
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _top_p_filter(logits, p: float):
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with mass >= p (always keep top-1)
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1)
+    # threshold = smallest kept logit
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample_logits(logits, key, *, strategy: str = "greedy_search",
+                  top_k: int = 0, top_p: float = 1.0,
+                  temperature: float = 1.0):
+    """logits [B, V] -> (token [B] int32, logprob [B] f32).  Pure jax —
+    usable inside scan.  ``key`` ignored for greedy."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if strategy == "greedy_search":
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        filt = logits.astype(jnp.float32)
+        if temperature != 1.0:
+            filt = filt / temperature
+        if top_k and top_k > 0:
+            filt = _top_k_filter(filt, top_k)
+        if top_p < 1.0:
+            filt = _top_p_filter(filt, top_p)
+        tok = jax.random.categorical(key, filt, axis=-1).astype(jnp.int32)
+    lp = jnp.take_along_axis(logp, tok[:, None].astype(jnp.int32),
+                             axis=-1)[:, 0]
+    return tok, lp
+
+
+# ---------------------------------------------------------------------------
+# GenerationMixin
+# ---------------------------------------------------------------------------
+
+class GenerationMixin:
+    """``model.generate`` for causal LMs exposing the static-cache
+    protocol: ``forward(input_ids, caches=[StaticCache...], pos=...)``
+    returning (logits, caches), plus ``gen_static_caches(batch, total)``.
+    """
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 max_length: Optional[int] = None,
+                 decode_strategy: str = "greedy_search",
+                 top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: int = 0, seed: int = 0):
+        """Returns (generated_ids [B, max_new_tokens] Tensor,
+        scores [B] cumulative logprob Tensor) — paddlenlp-shaped
+        (generated portion only, prompt excluded)."""
+        from ..tensor import Tensor
+        enforce(decode_strategy in ("greedy_search", "sampling"),
+                f"unsupported decode_strategy {decode_strategy!r} "
+                "(beam_search not yet implemented)")
+        ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+        b, s = ids.shape
+        if max_length is not None:
+            max_new_tokens = max_length - s
+        enforce(max_new_tokens > 0, "nothing to generate")
+
+        key_static = (b, s, max_new_tokens, decode_strategy, int(top_k),
+                      float(top_p), float(temperature), eos_token_id,
+                      int(pad_token_id))
+        # bounded LRU: each (batch, prompt-len, ...) signature is a full
+        # XLA compile of the decode loop — keep the last 8 only (serving
+        # with highly variable prompt lengths should bucket/pad upstream)
+        cache = getattr(self, "_gen_engines", None)
+        if cache is None:
+            cache = self._gen_engines = {}
+        engine = cache.pop(key_static, None)
+        if engine is None:
+            engine = self._build_gen_engine(*key_static)
+        cache[key_static] = engine
+        while len(cache) > 8:
+            cache.pop(next(iter(cache)))
+        params = self.raw_state_dict()
+        out_ids, scores = engine(params, jnp.asarray(ids),
+                                 jax.random.key(seed))
+        return Tensor(out_ids), Tensor(scores)
+
+    def _build_gen_engine(self, b, s, max_new, strategy, top_k, top_p,
+                          temperature, eos_token_id, pad_token_id):
+        from ..autograd import tape
+        from ..nn.layer import functional_state
+        from ..tensor import Tensor
+        model = self
+        total = s + max_new
+
+        def fwd(params, token_ids, caches, pos, prefill=False):
+            """One model call under functional params; returns raw
+            (last-position logits [B, V], caches)."""
+            with tape.no_grad(), functional_state(model, params):
+                caches_t = [StaticCache(Tensor(c.k, stop_gradient=True),
+                                        Tensor(c.v, stop_gradient=True))
+                            for c in caches]
+                logits, new_caches = model(
+                    Tensor(token_ids, stop_gradient=True),
+                    caches=caches_t, pos=Tensor(pos, stop_gradient=True),
+                    prefill=prefill)
+            raw_caches = [StaticCache(c.k.value, c.v.value)
+                          for c in new_caches]
+            return logits.value[:, -1], raw_caches
+
+        def run(params, ids, key):
+            caches = [StaticCache(c.k.value, c.v.value)
+                      for c in model.gen_static_caches(b, total)]
+            logits0, caches = fwd(params, ids, caches, jnp.int32(0),
+                                  prefill=True)
+            key, sub = jax.random.split(key)
+            tok, lp = sample_logits(
+                logits0, sub, strategy=strategy, top_k=top_k, top_p=top_p,
+                temperature=temperature)
+            done = jnp.zeros((b,), bool) if eos_token_id is None else \
+                (tok == eos_token_id)
+            scores = lp
+
+            def body(carry, _):
+                tok, caches, pos, key, done, scores = carry
+                logits, caches = fwd(params, tok[:, None], caches, pos)
+                key, sub = jax.random.split(key)
+                nxt, lp = sample_logits(
+                    logits, sub, strategy=strategy, top_k=top_k,
+                    top_p=top_p, temperature=temperature)
+                nxt = jnp.where(done, jnp.int32(pad_token_id), nxt)
+                scores = scores + jnp.where(done, 0.0, lp)
+                if eos_token_id is not None:
+                    done = done | (nxt == eos_token_id)
+                return (nxt, caches, pos + 1, key, done, scores), nxt
+
+            if max_new > 1:
+                carry = (tok, caches, jnp.int32(s), key, done, scores)
+                (_, _, _, _, _, scores), toks = jax.lax.scan(
+                    body, carry, None, length=max_new - 1)
+                all_toks = jnp.concatenate([tok[:, None], toks.T], axis=1)
+            else:
+                all_toks = tok[:, None]
+            return all_toks, scores
+
+        return jax.jit(run)

@@ -46,7 +46,7 @@ def _pick_blocks(sq: int, sk: int):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk):
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -55,10 +55,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: K block strictly above the diagonal band is fully masked
+    # causal: K block strictly above the diagonal band is fully masked.
+    # off = sk - sq maps Q rows to the LAST sq key positions (decode /
+    # chunked prefill: phi flash_attn_kernel's causal convention).
     run = True
     if causal:
-        run = ik * bk < (iq + 1) * bq
+        run = ik * bk < off + (iq + 1) * bq
 
     @pl.when(run)
     def _():
@@ -69,7 +71,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (iq * bq + rows) >= (ik * bk + cols)
+            mask = (off + iq * bq + rows) >= (ik * bk + cols)
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, 0][:, None]                        # [bq, 1]
@@ -102,11 +104,12 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
     group = h // hk
     nq, nk = sq // bq, sk // bk
     scale = 1.0 / math.sqrt(d)
+    off = sk - sq
 
     grid = (b, h, nq, nk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, off=off),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -138,7 +141,7 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk):
+                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk, off):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -147,7 +150,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = ik * bk < (iq + 1) * bq
+        run = ik * bk < off + (iq + 1) * bq
 
     @pl.when(run)
     def _():
@@ -162,7 +165,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (iq * bq + rows) >= (ik * bk + cols)
+            mask = (off + iq * bq + rows) >= (ik * bk + cols)
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -183,7 +186,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bk, nq):
+                    *, scale, causal, bq, bk, nq, off):
     ik, iq = pl.program_id(2), pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -193,7 +196,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = ik * bk < (iq + 1) * bq
+        run = ik * bk < off + (iq + 1) * bq
 
     @pl.when(run)
     def _():
@@ -208,7 +211,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (iq * bq + rows) >= (ik * bk + cols)
+            mask = (off + iq * bq + rows) >= (ik * bk + cols)
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
@@ -238,10 +241,11 @@ def _bwd(causal, bq, bk, res, do):
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)                                  # [b, h, sq]
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
+    off = sk - sq
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, off=off),
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -264,7 +268,7 @@ def _bwd(causal, bq, bk, res, do):
     # dk/dv per query head; GQA group-sum happens below
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, off=off),
         grid=(b, h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
@@ -320,13 +324,15 @@ flash_attention_bhsd.defvjp(_fwd_rule, _bwd)
 def flash_attention_raw(q, k, v, causal: bool = False):
     """[B, S, H, D] entry used by F.scaled_dot_product_attention.
 
-    Raises on shapes the kernel does not cover (caller falls back to the
-    jnp reference): cross-length causal decode, tiny/odd dims.
+    Causal with sq < sk treats Q as the LAST sq positions (KV-cache
+    decode / chunked prefill).  Raises on shapes the kernel does not
+    cover (caller falls back to the jnp reference): sq > sk causal,
+    tiny/odd dims.
     """
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
-    if causal and sq != sk:
-        raise NotImplementedError("causal flash kernel needs sq == sk")
+    if causal and sq > sk:
+        raise NotImplementedError("causal flash kernel needs sq <= sk")
     if d not in (64, 128, 256) or h % hk or sq % 8 or sk % 8:
         raise NotImplementedError("flash kernel shape constraints")
     bq, bk = _pick_blocks(sq, sk)

@@ -79,11 +79,38 @@ def test_grads_match_oracle(causal):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("sq,sk", [(64, 256), (8, 128)])
+def test_causal_decode_offset(sq, sk):
+    """Causal sq<sk: Q rows are the LAST sq positions (chunked prefill /
+    KV-cache decode)."""
+    rng = np.random.default_rng(2)
+    b, h, hk, d = 1, 4, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, sk, hk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, sk, hk, d)), jnp.float32)
+    got = _run(functools.partial(flash_attention_raw, causal=True), q, k, v)
+    want = _oracle(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(jnp.tanh(flash_attention_raw(q, k, v, causal=True)))
+
+    def loss_oracle(q, k, v):
+        return jnp.sum(jnp.tanh(_oracle(q, k, v, True)))
+
+    g_got = _run(jax.grad(loss_kernel, argnums=(0, 1, 2)), q, k, v)
+    g_want = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for got_g, want_g, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                                   atol=5e-5, rtol=5e-5, err_msg=f"d{name}")
+
+
 def test_unsupported_shapes_raise():
     q = jnp.zeros((1, 64, 4, 32))  # d=32 not MXU-tileable
     with pytest.raises(NotImplementedError):
         flash_attention_raw(q, q, q, causal=False)
-    q = jnp.zeros((1, 32, 4, 128))
-    k = jnp.zeros((1, 64, 4, 128))
+    q = jnp.zeros((1, 64, 4, 128))
+    k = jnp.zeros((1, 32, 4, 128))  # causal sq > sk undefined
     with pytest.raises(NotImplementedError):
         flash_attention_raw(q, k, k, causal=True)
