@@ -124,15 +124,40 @@ def save_state_dict(state_dict, path: str, process_group=None,
 
     Each process writes only its own non-replica shards; the coordinator
     writes the manifest.  With ``async_save=True`` the host->disk writes
-    happen on a daemon thread (device->host copies are still taken
+    happen on a background thread (device->host copies are still taken
     synchronously so training may mutate/donate the state immediately);
     the returned Thread can be join()ed.
+
+    Crash safety: every save writes its chunks into a fresh
+    ``data-<nonce>/`` subdirectory and commits by atomically replacing
+    the manifest afterwards, so re-saving into the same path can never
+    mix chunks of two saves under one manifest; a crash mid-save leaves
+    the previous checkpoint fully intact (the orphaned data dir is
+    garbage-collected by the next successful save).  Multi-host callers
+    must call this collectively from the main thread: the save nonce is
+    agreed via a broadcast at entry (which doubles as an entry barrier,
+    invalidating any stale completion markers from interrupted saves).
     """
     os.makedirs(path, exist_ok=True)
+    nproc = jax.process_count()
+    pidx = jax.process_index()
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+        seed = np.uint32(int.from_bytes(os.urandom(4), "little"))
+        nonce = format(int(multihost_utils.broadcast_one_to_all(
+            seed, is_source=pidx == coordinator_rank)), "08x")
+    else:
+        nonce = format(int.from_bytes(os.urandom(4), "little"), "08x")
+    data_dir = f"data-{nonce}"
+    os.makedirs(os.path.join(path, data_dir), exist_ok=True)
+
     flat = _flatten(state_dict)
     manifest: Dict[str, Any] = {"version": _VERSION, "arrays": {},
-                               "literals": {}}
+                               "literals": {}, "data_dir": data_dir}
     writes: List[Tuple[str, np.ndarray]] = []
+
+    def chunk_path(key, box):
+        return f"{data_dir}/{_fname(key, box)}"
 
     for key, leaf in flat.items():
         if isinstance(leaf, Tensor):
@@ -143,13 +168,16 @@ def save_state_dict(state_dict, path: str, process_group=None,
             manifest["literals"][key] = leaf
             continue
         if not isinstance(leaf, jax.Array):
+            # host-local numpy leaf: identical on every process by the
+            # collective-call contract — only the coordinator writes it
             leaf = np.asarray(leaf)
             box = _norm_box((slice(None),) * leaf.ndim, leaf.shape)
-            writes.append((os.path.join(path, _fname(key, box)),
-                           np.asarray(leaf)))
+            if pidx == coordinator_rank:
+                writes.append((os.path.join(path, chunk_path(key, box)),
+                               np.asarray(leaf)))
             manifest["arrays"][key] = {
                 "global_shape": list(leaf.shape), "dtype": str(leaf.dtype),
-                "chunks": [{"file": _fname(key, box),
+                "chunks": [{"file": chunk_path(key, box),
                             "box": [list(b) for b in box]}]}
             continue
 
@@ -160,7 +188,7 @@ def save_state_dict(state_dict, path: str, process_group=None,
         boxes = sorted({_norm_box(idx, shape) for idx in idx_map.values()})
         manifest["arrays"][key] = {
             "global_shape": list(shape), "dtype": str(leaf.dtype),
-            "chunks": [{"file": _fname(key, b),
+            "chunks": [{"file": chunk_path(key, b),
                         "box": [list(x) for x in b]} for b in boxes]}
         # process-local (fully-addressable) arrays look identical on every
         # multi-host process — e.g. an RNG key or a host-replicated scalar.
@@ -168,18 +196,15 @@ def save_state_dict(state_dict, path: str, process_group=None,
         # on the same chunk path, and per-process divergence (differently
         # seeded hosts) would be collapsed nondeterministically.  Global
         # arrays are written by whichever process holds the replica-0 shard.
-        if (leaf.is_fully_addressable and jax.process_count() > 1
-                and jax.process_index() != coordinator_rank):
+        if (leaf.is_fully_addressable and nproc > 1
+                and pidx != coordinator_rank):
             continue
         for shard in leaf.addressable_shards:
             if shard.replica_id != 0:
                 continue
             box = _norm_box(shard.index, shape)
-            writes.append((os.path.join(path, _fname(key, box)),
+            writes.append((os.path.join(path, chunk_path(key, box)),
                            np.asarray(shard.data)))
-
-    nproc = jax.process_count()
-    pidx = jax.process_index()
 
     def flush():
         for fpath, arr in writes:
@@ -187,16 +212,19 @@ def save_state_dict(state_dict, path: str, process_group=None,
         # the manifest is the commit point: written only after every chunk
         # is flushed, via tmp+rename so readers never see a manifest that
         # references missing/truncated chunk files.  Multi-host sync uses
-        # per-process marker files on the (shared) checkpoint dir — NOT a
-        # device collective, which on a background thread could interleave
-        # with the main thread's training collectives and deadlock.
+        # per-save-nonce marker files on the (shared) checkpoint dir — NOT
+        # a device collective, which on a background thread could
+        # interleave with the main thread's training collectives and
+        # deadlock.  The nonce in the marker name means markers from an
+        # interrupted earlier save can never satisfy this wait.
         if nproc > 1:
-            with open(os.path.join(path, f".proc{pidx}.done"), "w"):
+            with open(os.path.join(path, f".{nonce}.proc{pidx}.done"),
+                      "w"):
                 pass
         if pidx == coordinator_rank:
             if nproc > 1:
                 deadline = time.monotonic() + 600.0
-                want = [os.path.join(path, f".proc{i}.done")
+                want = [os.path.join(path, f".{nonce}.proc{i}.done")
                         for i in range(nproc)]
                 while not all(os.path.exists(w) for w in want):
                     enforce(time.monotonic() < deadline,
@@ -206,10 +234,16 @@ def save_state_dict(state_dict, path: str, process_group=None,
             with open(tmp, "w") as f:
                 json.dump(manifest, f, indent=1)
             os.replace(tmp, os.path.join(path, _METADATA))
-            if nproc > 1:
-                for i in range(nproc):
+            # GC: orphaned data dirs from older/interrupted saves, and
+            # this save's markers (only AFTER the commit point)
+            import shutil
+            for entry in os.listdir(path):
+                full = os.path.join(path, entry)
+                if entry.startswith("data-") and entry != data_dir:
+                    shutil.rmtree(full, ignore_errors=True)
+                elif entry.startswith(".") and entry.endswith(".done"):
                     try:
-                        os.remove(os.path.join(path, f".proc{i}.done"))
+                        os.remove(full)
                     except OSError:
                         pass
 

@@ -1,0 +1,10 @@
+"""tests/ conftest: fleet/mesh state is torn down after every test so
+topology-building tests can't leak meshes into each other."""
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_fleet_state():
+    yield
+    from paddle_tpu.distributed import fleet
+    fleet.reset()

@@ -1,0 +1,9 @@
+"""Shared distributed-test helpers (single definition — see conftest)."""
+import paddle_tpu.distributed as dist
+
+
+def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
+    s = dist.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                        "sharding_degree": sharding, "sep_degree": sep}
+    return s

@@ -22,17 +22,7 @@ from paddle_tpu.models.gpt import (GPTForCausalLM, GPTPretrainingCriterion,
                                    gpt2_tiny_config)
 
 
-@pytest.fixture(autouse=True)
-def reset_fleet():
-    yield
-    fleet.reset()
-
-
-def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
-    s = dist.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
-                        "sharding_degree": sharding, "sep_degree": sep}
-    return s
+from helpers import make_strategy
 
 
 class TestRoundTrip:
@@ -58,6 +48,22 @@ class TestRoundTrip:
         np.testing.assert_array_equal(np.asarray(tmpl["lst"][0]), 2.5)
         np.testing.assert_array_equal(np.asarray(tmpl["lst"][1]),
                                       np.zeros((2, 2)))
+
+    def test_resave_same_dir_commits_atomically(self, tmp_path):
+        """Each save writes a fresh data-<nonce>/ dir; a re-save to the
+        same path never mixes chunks with the previous save and GCs the
+        old data dir after commit."""
+        import os
+        a = np.arange(6, dtype=np.float32)
+        b = np.arange(6, dtype=np.float32) * 10
+        save_state_dict({"x": a}, str(tmp_path / "ck"))
+        save_state_dict({"x": b}, str(tmp_path / "ck"))
+        out = load_state_dict({"x": np.zeros(6, np.float32)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), b)
+        datadirs = [d for d in os.listdir(tmp_path / "ck")
+                    if d.startswith("data-")]
+        assert len(datadirs) == 1
 
     def test_missing_key_raises(self, tmp_path):
         save_state_dict({"a": np.zeros(3)}, str(tmp_path / "ck"))
@@ -111,7 +117,8 @@ class TestReshardOnLoad:
         # dp-replicated: only the 4 mp shards hit disk
         assert len(meta["arrays"]["x"]["chunks"]) == 4
         import os
-        files = [f for f in os.listdir(tmp_path / "ck") if f.endswith(".npy")]
+        files = [f for f in os.listdir(tmp_path / "ck" / meta["data_dir"])
+                 if f.endswith(".npy")]
         assert len(files) == 4
 
 

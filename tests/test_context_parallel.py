@@ -18,17 +18,7 @@ from paddle_tpu.distributed.context_parallel import sep_attention_raw
 from paddle_tpu.ops import _nn
 
 
-@pytest.fixture(autouse=True)
-def reset_fleet():
-    yield
-    fleet.reset()
-
-
-def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
-    s = dist.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
-                        "sharding_degree": sharding, "sep_degree": sep}
-    return s
+from helpers import make_strategy
 
 
 def _qkv(b=2, s=32, h=4, hk=4, d=16, seed=0):

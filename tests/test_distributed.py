@@ -21,25 +21,7 @@ from paddle_tpu.models.gpt import (GPTForCausalLM, GPTPretrainingCriterion,
                                    gpt2_tiny_config)
 
 
-@pytest.fixture(autouse=True)
-def reset_fleet():
-    yield
-    # reset fleet singleton between tests
-    from paddle_tpu.distributed import fleet as fleet_mod
-    fleet_mod._HCG = None
-    fleet_mod._STRATEGY = None
-    from paddle_tpu.distributed import collective as coll
-    coll._DEFAULT_GROUP = None
-    from paddle_tpu.distributed.auto_parallel import set_mesh
-    import paddle_tpu.distributed.auto_parallel as ap
-    ap._GLOBAL_MESH = None
-
-
-def make_strategy(dp=1, mp=1, pp=1, sharding=1, sep=1):
-    s = dist.DistributedStrategy()
-    s.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
-                        "sharding_degree": sharding, "sep_degree": sep}
-    return s
+from helpers import make_strategy
 
 
 class TestTopology:
