@@ -103,13 +103,16 @@ def _device():
 def _time_step(step, data, iters):
     import jax
     loss = step(data)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     loss = step(data)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(data)
-    jax.block_until_ready(loss)
+    # device_get is the timing barrier: it forces materialization of the
+    # whole donated-state chain (block_until_ready has been observed to
+    # return early through the remote PJRT tunnel)
+    jax.device_get(loss)
     dt = time.perf_counter() - t0
     return dt / iters, loss
 
@@ -258,6 +261,89 @@ def bench_moe():
                       "final_loss": float(np.asarray(jax.device_get(loss)))}}
 
 
+def bench_ernie():
+    """Ladder #3: ERNIE-4.5-class (dense backbone of the TP+PP recipe;
+    pp/mp degrees only exist on multi-chip meshes — the dryrun validates
+    them, this measures single-chip throughput of the same model)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import CompiledTrainStep
+    from paddle_tpu.models.ernie import Ernie45Config, Ernie45ForCausalLM
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = Ernie45Config(vocab_size=103424, hidden_size=1536,
+                            intermediate_size=6144, num_hidden_layers=16,
+                            num_attention_heads=12, num_key_value_heads=4,
+                            max_position_embeddings=8192, recompute=True)
+        batch, seq = 2, 8192
+    else:
+        from paddle_tpu.models.ernie import ernie45_tiny_config
+        cfg = ernie45_tiny_config()
+        batch, seq = 2, 64
+    paddle.seed(0)
+    model = Ernie45ForCausalLM(cfg)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, lambda m, b: m(b["input_ids"],
+                                                   labels=b["labels"]), opt)
+    data = _train_batch(cfg.vocab_size, batch, seq)
+    step_time, loss = _time_step(step, data, 20 if on_tpu else 2)
+    h, layers = cfg.hidden_size, cfg.num_hidden_layers
+    n = _param_count(h, cfg.intermediate_size, layers,
+                     cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.vocab_size)
+    tps = batch * seq / step_time
+    mfu6n, mfu_attn = _mfu_pair(n, layers, h, seq, tps, peak)
+    return {"metric": "ernie45-class_tokens_per_sec_per_chip",
+            "unit": "tokens/sec", "value": round(tps, 1),
+            "extra": {"device_kind": kind, "batch": batch, "seq": seq,
+                      "params": n,
+                      "mfu": round(mfu6n, 4) if mfu6n else None,
+                      "mfu_attn": round(mfu_attn, 4) if mfu_attn else None,
+                      "final_loss": float(np.asarray(jax.device_get(loss)))}}
+
+
+def bench_dit():
+    """Ladder #4: DiT (conv+groupnorm family) imgs/sec."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import CompiledTrainStep
+    from paddle_tpu.models.dit import DiTConfig, DiTWithDiffusion
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        # DiT-L/2-class on 32x32x4 latents (batch sized for 16 GB with
+        # full activations — DiT has no remat knob yet)
+        cfg = DiTConfig(input_size=32, patch_size=2, hidden_size=1024,
+                        depth=24, num_heads=16)
+        batch = 16
+    else:
+        from paddle_tpu.models.dit import dit_tiny_config
+        cfg = dit_tiny_config()
+        batch = 4
+    paddle.seed(0)
+    model = DiTWithDiffusion(cfg)
+    model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, lambda m, b: m(b["x"], b["y"]), opt)
+    rng = np.random.default_rng(0)
+    data = {"x": rng.standard_normal(
+        (batch, cfg.in_channels, cfg.input_size, cfg.input_size)
+    ).astype(np.float32),
+        "y": rng.integers(0, cfg.num_classes, (batch,)).astype(np.int32)}
+    step_time, loss = _time_step(step, data, 20 if on_tpu else 2)
+    return {"metric": "dit-l2_imgs_per_sec", "unit": "imgs/sec",
+            "value": round(batch / step_time, 1),
+            "extra": {"device_kind": kind, "batch": batch,
+                      "step_time_s": round(step_time, 4),
+                      "final_loss": float(np.asarray(jax.device_get(loss)))}}
+
+
 def bench_decode():
     """Decode tokens/sec through the jitted generate() loop."""
     import paddle_tpu as paddle
@@ -293,8 +379,8 @@ def bench_decode():
 
 def main():
     if "--ladder" in sys.argv:
-        rows = [bench_headline(emit=False), bench_gpt2(), bench_moe(),
-                bench_decode()]
+        rows = [bench_headline(emit=False), bench_gpt2(), bench_ernie(),
+                bench_dit(), bench_moe(), bench_decode()]
         for r in rows:
             print(json.dumps(r))
         return

@@ -32,6 +32,9 @@ from . import models
 from . import incubate
 from .framework import io as _framework_io
 from .framework.io import load, save
+from . import metric
+from . import profiler
+from . import visualdl
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

@@ -1,0 +1,120 @@
+"""paddle.autograd.PyLayer — user-defined forward/backward.
+
+Reference parity: python/paddle/autograd/py_layer.py (``PyLayer`` with
+static ``forward(ctx, *args)`` / ``backward(ctx, *grads)`` and
+``ctx.save_for_backward``) over the eager PyLayer grad node.
+
+TPU-native design: ``apply`` wraps the user functions in a
+``jax.custom_vjp`` and dispatches through :func:`apply_op`, so the
+custom backward is honored BOTH by the eager tape (loss.backward) and
+by jax autodiff inside compiled training steps (jax.grad sees the
+custom_vjp) — one definition, both engines.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from ..common.errors import enforce
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Any] = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # paddle also allows arbitrary attributes on ctx — plain object attrs
+    # work here (the ctx object itself is threaded through the closure)
+
+
+class PyLayer:
+    """Subclass with @staticmethod forward(ctx, *args, **kwargs) and
+    @staticmethod backward(ctx, *grad_outputs); call via .apply."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..autograd import tape
+        from ..tensor import Tensor, apply_op
+        import jax.numpy as jnp
+
+        ctx = PyLayerContext()
+
+        # float tensors are the differentiable primals of the custom op;
+        # everything else (ints, python values) is closed over in place
+        is_diff = [isinstance(a, Tensor)
+                   and jnp.issubdtype(jnp.asarray(a.value).dtype,
+                                      jnp.floating)
+                   for a in args]
+        diff_pos = [i for i, d in enumerate(is_diff) if d]
+
+        def run_forward(diff_arrays):
+            full = list(args)
+            for j, i in enumerate(diff_pos):
+                full[i] = Tensor(diff_arrays[j], stop_gradient=True)
+            with tape.no_grad():
+                outs = cls.forward(ctx, *full, **kwargs)
+            single = not isinstance(outs, (list, tuple))
+            outs_t = [outs] if single else list(outs)
+            arrs = tuple(o.value if isinstance(o, Tensor) else jnp.asarray(o)
+                         for o in outs_t)
+            return arrs, single
+
+        @jax.custom_vjp
+        def op(*diff_arrays):
+            arrs, _ = run_forward(diff_arrays)
+            return arrs
+
+        def op_fwd(*diff_arrays):
+            arrs, _ = run_forward(diff_arrays)
+            saved = tuple(t.value if isinstance(t, Tensor) else t
+                          for t in ctx._saved)
+            return arrs, saved
+
+        def op_bwd(saved, cts):
+            ctx._saved = [Tensor(s, stop_gradient=True) for s in saved]
+            with tape.no_grad():
+                grads = cls.backward(
+                    ctx, *[Tensor(c, stop_gradient=True) for c in cts])
+            grads = [grads] if not isinstance(grads, (list, tuple)) \
+                else list(grads)
+            enforce(len(grads) == len(diff_pos),
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {len(diff_pos)} differentiable inputs")
+            out = []
+            for g, i in zip(grads, diff_pos):
+                ref = args[i]
+                if g is None:
+                    out.append(jnp.zeros_like(ref.value))
+                else:
+                    out.append((g.value if isinstance(g, Tensor)
+                                else jnp.asarray(g)).astype(ref.dtype))
+            return tuple(out)
+
+        op.defvjp(op_fwd, op_bwd)
+        op.__name__ = f"pylayer_{cls.__name__}"
+
+        result = apply_op(op, *[args[i] for i in diff_pos])
+        # op always returns a tuple; unwrap the singleton like paddle does
+        # when forward returned a bare Tensor
+        outs = result if isinstance(result, (list, tuple)) else [result]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def once_differentiable(fn):  # paddle API-parity decorator (no-op here)
+    return fn

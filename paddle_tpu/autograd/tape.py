@@ -70,18 +70,27 @@ class GradNode:
     structure) to input cotangents, one per differentiable input.  Each
     input edge is either another node's output (``('n', node, out_idx)``)
     or a leaf tensor (``('l', tensor)``) whose ``.grad`` accumulates.
+
+    ``out_hooks`` (out_idx -> [hook]) are ``Tensor.register_hook`` user
+    hooks on this node's outputs — fired on the tensor's final
+    accumulated cotangent before it enters ``vjp_fn``.  ``saved`` keeps
+    what :func:`grad`'s ``create_graph`` mode needs to re-express the
+    VJP as an explicit function of the primals (see _grad_create_graph).
     """
 
-    __slots__ = ("name", "vjp_fn", "in_edges", "n_outputs", "out_tree", "hooks")
+    __slots__ = ("name", "vjp_fn", "in_edges", "n_outputs", "out_tree",
+                 "hooks", "out_hooks", "saved")
 
     def __init__(self, name: str, vjp_fn: Callable, in_edges: List[Tuple],
-                 n_outputs: int, out_tree):
+                 n_outputs: int, out_tree, saved=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.in_edges = in_edges
         self.n_outputs = n_outputs
         self.out_tree = out_tree
         self.hooks: List[Callable] = []
+        self.out_hooks = {}
+        self.saved = saved
 
     def __repr__(self):
         return f"GradNode({self.name}, n_out={self.n_outputs})"
@@ -147,6 +156,10 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
             ct if ct is not None else jnp.zeros(shape, dtype)
             for ct, (shape, dtype) in zip(node_cts, node.out_tree["avals"])
         ]
+        # Tensor.register_hook on this node's outputs: hook sees (and may
+        # replace) the final accumulated grad of that tensor
+        for idx, hooks in node.out_hooks.items():
+            filled[idx] = _run_tensor_hooks(hooks, filled[idx], Tensor)
         out_struct = jax.tree_util.tree_unflatten(node.out_tree["treedef"], filled)
         in_cts = node.vjp_fn(out_struct)
         for hook in node.hooks:
@@ -164,10 +177,22 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
             else:
                 leaf = edge[1]
                 if leaf_filter is None or id(leaf) in leaf_filter:
+                    hooks = getattr(leaf, "_hooks", None)
+                    if hooks:
+                        ct = _run_tensor_hooks(hooks, ct, Tensor)
                     leaf._accumulate_grad(ct)
         if not retain_graph:
             node.vjp_fn = _freed_vjp
         del cts[id(node)]
+
+
+def _run_tensor_hooks(hooks, ct, Tensor):
+    """Run user grad hooks: hook(Tensor) -> Tensor | None (keep)."""
+    for hook in hooks:
+        res = hook(Tensor(ct))
+        if res is not None:
+            ct = res.value if isinstance(res, Tensor) else jnp.asarray(res)
+    return ct
 
 
 def _freed_vjp(*_a, **_k):
@@ -187,9 +212,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     from ..tensor import Tensor
 
     if create_graph:
-        raise NotImplementedError(
-            "create_graph on the eager tape is unsupported; use "
-            "paddle_tpu.jit functional transforms for higher-order grads")
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -220,4 +244,127 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
             if out._node is not None:
                 for n in _topo_order(out._node):
                     n.vjp_fn = _freed_vjp
+    return results
+
+
+# ---------------------------------------------------------------------------
+# create_graph (double-grad): tensor-mode tape walk
+# ---------------------------------------------------------------------------
+
+def _grad_create_graph(outputs, inputs, grad_outputs=None,
+                       allow_unused=False):
+    """``paddle.grad(create_graph=True)``: walk the tape with TENSOR
+    cotangents, re-expressing each node's VJP as an apply_op over
+    (cotangents, primals) — so the produced grads are themselves taped
+    and differentiable (the reference's double-grad, fluid/eager
+    higher-order path).
+
+    Requires nodes recorded with ``saved`` info (all apply_op nodes);
+    nodes built without it (custom engines) raise.
+    """
+    from ..tensor import Tensor, apply_op
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    # tensor-cotangent accumulators
+    cts = {}
+
+    def add_ct(key, t):
+        cur = cts.get(key)
+        cts[key] = t if cur is None else cur + t
+
+    roots = []
+    for out, g in zip(outputs, grad_outputs):
+        if out._node is None:
+            continue
+        g0 = Tensor(jnp.ones_like(out.value)) if g is None else (
+            g if isinstance(g, Tensor) else Tensor(g))
+        add_ct((id(out._node), out._out_idx), g0)
+        roots.append(out._node)
+
+    order: List[GradNode] = []
+    seen = set()
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+
+    # leaf grads keyed by id(tensor)
+    leaf_grads = {}
+    input_ids = {id(t) for t in inputs}
+
+    for node in reversed(order):
+        node_ct_ts = [cts.get((id(node), i)) for i in range(node.n_outputs)]
+        if all(t is None for t in node_ct_ts):
+            continue
+        if node.saved is None:
+            raise RuntimeError(
+                f"node {node.name} lacks saved primals; create_graph "
+                "needs apply_op-recorded nodes")
+        raw_fn, template, kwargs, leaves, diff_idx, arrays = node.saved
+        filled = [t if t is not None else Tensor(jnp.zeros(s_, d_))
+                  for t, (s_, d_) in zip(node_ct_ts,
+                                         node.out_tree["avals"])]
+        treedef = node.out_tree["treedef"]
+        n_out = len(filled)
+        n_diff = len(diff_idx)
+
+        def vjp_of_op(*flat_args, _raw=raw_fn, _template=template,
+                      _kwargs=kwargs, _diff=diff_idx, _arrays=arrays,
+                      _treedef=treedef, _n_out=n_out):
+            ct_flat = flat_args[:_n_out]
+            primals = flat_args[_n_out:]
+
+            def rebuild(arrs):
+                it = iter(arrs)
+                out = []
+                for kind, v in _template:
+                    if kind == "t":
+                        out.append(next(it))
+                    elif kind == "tl":
+                        out.append([next(it) for _ in range(v)])
+                    else:
+                        out.append(v)
+                return out
+
+            def f(*diff_arrays):
+                full = list(_arrays)
+                for j, i in enumerate(_diff):
+                    full[i] = diff_arrays[j]
+                return _raw(*rebuild(full), **_kwargs)
+
+            _, vjp_fn = jax.vjp(f, *primals)
+            return vjp_fn(jax.tree_util.tree_unflatten(_treedef,
+                                                       list(ct_flat)))
+
+        primal_tensors = [leaves[i] if isinstance(leaves[i], Tensor)
+                          else Tensor(arrays[i]) for i in diff_idx]
+        in_ct = apply_op(vjp_of_op, *filled, *primal_tensors)
+        in_ct = in_ct if isinstance(in_ct, (list, tuple)) else [in_ct]
+        for edge, ct_t in zip(node.in_edges, in_ct):
+            if ct_t is None:
+                continue
+            if edge[0] == "n":
+                add_ct((id(edge[1]), edge[2]), ct_t)
+            else:
+                leaf = edge[1]
+                cur = leaf_grads.get(id(leaf))
+                leaf_grads[id(leaf)] = ct_t if cur is None else cur + ct_t
+
+    results = []
+    for t in inputs:
+        g = None
+        if t._node is not None:
+            g = cts.get((id(t._node), t._out_idx))
+        if g is None:
+            g = leaf_grads.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "an input was not used in the graph (pass "
+                "allow_unused=True)")
+        results.append(g)
     return results

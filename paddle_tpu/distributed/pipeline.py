@@ -199,6 +199,9 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def forward(self, x, *args, **kwargs):
+        # side inputs (e.g. rope cos/sin) are forwarded to every layer —
+        # dropping them silently diverged from the sequential-parity
+        # contract (ADVICE.md round-1)
         for lyr in self.run_function:
-            x = lyr(x)
+            x = lyr(x, *args, **kwargs)
         return x

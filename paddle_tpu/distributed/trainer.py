@@ -52,6 +52,8 @@ class ShardedTrainStep(CompiledTrainStep):
         # same fused step as the parent, jitted with explicit state
         # shardings so donation + placement are stable; batch/lr/key
         # shardings are propagated by XLA
+        from ..jit.train import _maybe_enable_debug_nans
+        _maybe_enable_debug_nans()
         shardings = self.plan.state_shardings(self.state)
         self._step_fn = jax.jit(
             self._make_step(),

@@ -27,6 +27,16 @@ from ..tensor import Tensor
 __all__ = ["CompiledTrainStep"]
 
 
+def _maybe_enable_debug_nans():
+    """FLAGS_check_nan_inf for the compiled path: the reference scans op
+    outputs per step (fluid nan_inf_utils); the XLA-idiomatic analog is
+    jax_debug_nans, which re-runs the failing computation op-by-op and
+    raises at the first NaN-producing op."""
+    from ..common.flags import get_flag
+    if get_flag("check_nan_inf"):
+        jax.config.update("jax_debug_nans", True)
+
+
 def _to_arrays(tree):
     return jax.tree_util.tree_map(
         lambda x: x.value if isinstance(x, Tensor) else jnp.asarray(x), tree,
@@ -80,6 +90,7 @@ class CompiledTrainStep:
         return step
 
     def _build(self):
+        _maybe_enable_debug_nans()
         self._step_fn = jax.jit(
             self._make_step(), donate_argnums=(0,) if self._donate else ())
 

@@ -1,6 +1,10 @@
+from . import dit
+from . import ernie
 from . import gpt
 from . import llama
 from . import qwen2_moe
+from .dit import AutoencoderKL, DiT, DiTConfig, DiTWithDiffusion
+from .ernie import Ernie45Config, Ernie45ForCausalLM, Ernie45ForCausalLMPipe
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe,
                     LlamaModel, LlamaPretrainingCriterion)

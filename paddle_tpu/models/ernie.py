@@ -1,0 +1,209 @@
+"""ERNIE-4.5-class model family (config #3 of BASELINE.json).
+
+Reference parity: the reference's ERNIE-4.5 recipe class (PaddleNLP
+``ernie`` model family: RMSNorm + RoPE + GQA + SwiGLU backbone with
+ERNIE-4.5's heterogeneous MoE — leading dense layers, then MoE layers
+with shared experts and top-k routing with a load-balance aux loss) and
+its fleet TP+PP hybrid launch (SURVEY.md §2.3; BASELINE.json configs
+row "ERNIE-4.5 (TP+PP)").
+
+TPU-native design: weights carry Megatron ``dist_spec`` annotations so
+the same model runs 1-chip or on any (dp, sharding, mp) mesh; the TP+PP
+recipe is ``Ernie45ForCausalLMPipe`` — the dense backbone lowered
+through the SPMD GPipe engine (stage-stacked params on the ``pp`` axis,
+see distributed/pipeline.py), with Megatron TP specs on the trailing
+dims.  The heterogeneous-MoE variant (``moe_num_experts > 0``) runs on
+the eager/compiled path with GShard dense dispatch (nn/moe.py) whose
+all-to-all is emitted by GSPMD over the EP fold.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import ops as P
+from ..nn import functional as F
+from ..nn.common import Embedding, Linear
+from ..nn.container import LayerList
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.moe import MoELayer
+from ..nn.norm import RMSNorm
+from ..tensor import Tensor
+from .llama import (LlamaAttention, LlamaConfig, LlamaForCausalLMPipe,
+                    LlamaMLP, LlamaPretrainingCriterion, _rope_cos_sin)
+
+__all__ = ["Ernie45Config", "Ernie45ForCausalLM", "Ernie45ForCausalLMPipe",
+           "ernie45_tiny_config", "ernie45_a3b_config"]
+
+
+@dataclass
+class Ernie45Config:
+    vocab_size: int = 103424
+    hidden_size: int = 2560
+    intermediate_size: int = 12288
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 20
+    num_key_value_heads: int = 4
+    max_position_embeddings: int = 131072
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    recompute: bool = False
+    fuse_linear_cross_entropy: bool = True
+    # heterogeneous MoE (0 experts = dense model)
+    moe_num_experts: int = 0
+    moe_k: int = 6
+    moe_intermediate_size: int = 1536
+    moe_num_shared_experts: int = 2
+    moe_layer_start_index: int = 1      # leading layers stay dense
+    moe_aux_loss_coef: float = 0.001
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
+            initializer_range=self.initializer_range,
+            tie_word_embeddings=self.tie_word_embeddings,
+            use_flash_attention=self.use_flash_attention,
+            recompute=self.recompute,
+            fuse_linear_cross_entropy=self.fuse_linear_cross_entropy)
+
+
+def ernie45_a3b_config() -> Ernie45Config:
+    """ERNIE-4.5-21B-A3B-class shape: 64 experts top-6 + 2 shared,
+    first layer dense."""
+    return Ernie45Config(moe_num_experts=64, moe_k=6,
+                         moe_num_shared_experts=2,
+                         moe_layer_start_index=1)
+
+
+def ernie45_tiny_config(moe: bool = False) -> Ernie45Config:
+    return Ernie45Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        moe_num_experts=8 if moe else 0, moe_k=2,
+        moe_intermediate_size=32, moe_num_shared_experts=1,
+        moe_layer_start_index=1)
+
+
+class Ernie45DecoderLayer(Layer):
+    def __init__(self, config: Ernie45Config, layer_idx: int):
+        super().__init__()
+        c = config
+        self.input_layernorm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps)
+        self.self_attn = LlamaAttention(c.as_llama())
+        self.post_attention_layernorm = RMSNorm(c.hidden_size,
+                                                epsilon=c.rms_norm_eps)
+        self.is_moe = (c.moe_num_experts > 0
+                       and layer_idx >= c.moe_layer_start_index)
+        if self.is_moe:
+            self.mlp = MoELayer(
+                c.hidden_size, c.moe_num_experts, c.moe_intermediate_size,
+                k=c.moe_k,
+                shared_expert_intermediate=(c.moe_num_shared_experts
+                                            * c.moe_intermediate_size),
+                balance_loss_weight=1.0,
+                init_std=c.initializer_range,
+                num_layers_scale=c.num_hidden_layers)
+        else:
+            self.mlp = LlamaMLP(c.as_llama())
+
+    def forward(self, x, cos_sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos_sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        aux = self.mlp.aux_loss if self.is_moe else None
+        return x, aux
+
+
+class Ernie45ForCausalLM(Layer):
+    """Eager/compiled ERNIE-4.5-class causal LM (dense or hetero-MoE)."""
+
+    def __init__(self, config: Ernie45Config):
+        super().__init__()
+        self.config = config
+        c = config
+        init = Normal(0.0, c.initializer_range)
+        self.embed_tokens = Embedding(c.vocab_size, c.hidden_size,
+                                      weight_attr=init)
+        self.embed_tokens.weight.dist_spec = ("mp", None)
+        self.layers = LayerList([Ernie45DecoderLayer(c, i)
+                                 for i in range(c.num_hidden_layers)])
+        self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps)
+        if c.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(c.hidden_size, c.vocab_size,
+                                  bias_attr=False, weight_attr=init)
+            self.lm_head.weight.dist_spec = (None, "mp")
+        hd = c.hidden_size // c.num_attention_heads
+        rope = _rope_cos_sin(c.max_position_embeddings, hd, c.rope_theta)
+        self.register_buffer("rope_cos", Tensor(np.cos(rope)),
+                             persistable=False)
+        self.register_buffer("rope_sin", Tensor(np.sin(rope)),
+                             persistable=False)
+
+    def forward(self, input_ids, labels=None):
+        c = self.config
+        b, s = input_ids.shape
+        x = self.embed_tokens(input_ids)
+        cos_sin = (self.rope_cos[:s], self.rope_sin[:s])
+        aux_losses = []
+        for layer in self.layers:
+            if c.recompute:
+                from ..jit.recompute import recompute
+                x, aux = recompute(layer, x, cos_sin)
+            else:
+                x, aux = layer(x, cos_sin)
+            if aux is not None:
+                aux_losses.append(aux)
+        x = self.norm(x)
+        aux_total = None
+        if aux_losses:
+            aux_total = aux_losses[0]
+            for a in aux_losses[1:]:
+                aux_total = aux_total + a
+            aux_total = aux_total * c.moe_aux_loss_coef
+
+        if labels is not None and c.fuse_linear_cross_entropy:
+            if self.lm_head is None:
+                loss = F.fused_linear_cross_entropy(
+                    x, self.embed_tokens.weight, labels,
+                    transpose_weight=True)
+            else:
+                loss = F.fused_linear_cross_entropy(
+                    x, self.lm_head.weight, labels)
+            return loss + aux_total if aux_total is not None else loss
+        if self.lm_head is None:
+            logits = P.matmul(x, self.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        if labels is not None:
+            loss = LlamaPretrainingCriterion()(logits, labels)
+            return loss + aux_total if aux_total is not None else loss
+        return logits
+
+
+class Ernie45ForCausalLMPipe(LlamaForCausalLMPipe):
+    """The TP+PP recipe: ERNIE-4.5 dense backbone through the SPMD GPipe
+    engine (stage-stacked params sharded over ``pp``, Megatron TP specs
+    over ``mp``).  The heterogeneous-MoE variant is served by
+    Ernie45ForCausalLM (MoE layer stacks are non-uniform across stages,
+    which the stacked-scan pipe deliberately does not model)."""
+
+    def __init__(self, config: Ernie45Config, n_microbatches: int = 4):
+        from ..common.errors import enforce
+        enforce(config.moe_num_experts == 0,
+                "Ernie45ForCausalLMPipe is the dense TP+PP recipe; "
+                "use Ernie45ForCausalLM for the MoE variant")
+        super().__init__(config.as_llama(), n_microbatches=n_microbatches)
+        self.ernie_config = config

@@ -109,7 +109,19 @@ def _seq_parallel_raw(x):
     pm = get_mesh()
     if pm is None or pm.mesh.shape.get("sep", 1) <= 1:
         return x
-    spec = PartitionSpec(("dp", "sharding"), "sep", None)
+    # drop axes the dims cannot divide over (mirrors sharding.py's
+    # plan_param_spec behavior instead of failing at runtime — ADVICE.md r1)
+    shape = pm.mesh.shape
+    batch_axes = tuple(a for a in ("dp", "sharding")
+                       if shape.get(a, 1) > 1)
+    import math as _math
+    if batch_axes and x.shape[0] % _math.prod(
+            shape[a] for a in batch_axes):
+        batch_axes = ()
+    seq_axis = "sep" if x.shape[1] % shape["sep"] == 0 else None
+    if not batch_axes and seq_axis is None:
+        return x
+    spec = PartitionSpec(batch_axes if batch_axes else None, seq_axis, None)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(pm.mesh, spec))
 

@@ -111,9 +111,13 @@ class Qwen2MoeForCausalLM(Layer):
         self.layers = LayerList([Qwen2MoeDecoderLayer(c)
                                  for _ in range(c.num_hidden_layers)])
         self.norm = RMSNorm(c.hidden_size, epsilon=c.rms_norm_eps)
-        self.lm_head = Linear(c.hidden_size, c.vocab_size, bias_attr=False,
-                              weight_attr=Normal(0.0, c.initializer_range))
-        self.lm_head.weight.dist_spec = (None, "mp")
+        if c.tie_word_embeddings:
+            self.lm_head = None  # reuse embed_tokens.weight (ADVICE.md r1)
+        else:
+            self.lm_head = Linear(
+                c.hidden_size, c.vocab_size, bias_attr=False,
+                weight_attr=Normal(0.0, c.initializer_range))
+            self.lm_head.weight.dist_spec = (None, "mp")
         hd = c.hidden_size // c.num_attention_heads
         rope = _rope_cos_sin(c.max_position_embeddings, hd, c.rope_theta)
         self.register_buffer("rope_cos", Tensor(np.cos(rope)),
@@ -137,12 +141,23 @@ class Qwen2MoeForCausalLM(Layer):
         x = self.norm(x)
         if labels is not None:
             if c.fuse_linear_cross_entropy:
-                loss = F.fused_linear_cross_entropy(
-                    x, self.lm_head.weight, labels)
+                if self.lm_head is None:
+                    loss = F.fused_linear_cross_entropy(
+                        x, self.embed_tokens.weight, labels,
+                        transpose_weight=True)
+                else:
+                    loss = F.fused_linear_cross_entropy(
+                        x, self.lm_head.weight, labels)
             else:
-                loss = LlamaPretrainingCriterion()(self.lm_head(x), labels)
+                loss = LlamaPretrainingCriterion()(self._logits(x), labels)
             aux = aux_losses[0]
             for a in aux_losses[1:]:
                 aux = aux + a
             return loss + c.router_aux_loss_coef * aux
+        return self._logits(x)
+
+    def _logits(self, x):
+        if self.lm_head is None:
+            from .. import ops as P
+            return P.matmul(x, self.embed_tokens.weight, transpose_y=True)
         return self.lm_head(x)

@@ -44,9 +44,11 @@ def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef):
     gate_vals = gate_vals / jnp.clip(
         jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
-    # aux load-balance loss over top-1 assignment fractions
-    top1_mask = jax.nn.one_hot(expert_idx[:, 0], e)          # [T, E]
-    density = jnp.mean(top1_mask, axis=0)                    # fraction/expert
+    # aux load-balance loss over the FULL top-k assignment density (the
+    # reference's top-k gates count every selected slot, not just slot 0 —
+    # ADVICE.md round-1): fraction of routed slots landing on each expert
+    topk_onehot = jax.nn.one_hot(expert_idx, e)              # [T, k, E]
+    density = jnp.mean(jnp.sum(topk_onehot, axis=1), axis=0) / k
     density_proxy = jnp.mean(probs, axis=0)
     aux = balance_coef * e * jnp.sum(density * density_proxy)
     if z_coef:

@@ -296,9 +296,18 @@ def _conv_padding(padding, n, stride, dilation, ksize):
     return [tuple(p) for p in padding]
 
 
+def _match_conv_dtypes(x, weight):
+    """amp O2 contract: a low-precision conv weight pulls the input down
+    to its dtype (lax.conv requires equal dtypes; f32 accumulate below)."""
+    if x.dtype != weight.dtype:
+        x = x.astype(weight.dtype)
+    return x
+
+
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     """paddle F.conv2d: weight [C_out, C_in/groups, kH, kW]."""
+    x = _match_conv_dtypes(x, weight)
     n = 2
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -307,13 +316,14 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
         else ("NHWC", "OIHW", "NHWC"))
-    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # low-precision operands run the conv in their own dtype: the MXU
+    # accumulates partial products in f32 internally, and an explicit
+    # preferred_element_type here trips mixed-dtype operands in the
+    # autodiff transpose (dW-conv of bf16 primal x f32 cotangent)
     out = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=acc)
-    if acc is not None:
-        out = out.astype(x.dtype)
+        feature_group_count=groups)
     if bias is not None:
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         out = out + bias.reshape(bshape)
@@ -339,6 +349,7 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
+    x = _match_conv_dtypes(x, weight)
     n = 3
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -358,6 +369,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCHW"):
     """weight [C_in, C_out/groups, kH, kW] (paddle conv_transpose layout)."""
+    x = _match_conv_dtypes(x, weight)
     n = 2
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)

@@ -1,0 +1,209 @@
+"""paddle.profiler — tracing facade over jax.profiler.
+
+Reference parity: python/paddle/profiler/ (``Profiler`` with a
+wait/warmup/active ``make_scheduler`` state machine, ``RecordEvent``
+host ranges, chrome-trace export + summary tables) over the C++
+RecordEvent/CUPTI tracers (SURVEY.md §5 tracing row).
+
+TPU-native design: device+host tracing is jax.profiler's XPlane
+capture (viewable in TensorBoard's profile plugin / Perfetto — the
+trace-viewer replacement for chrome://tracing); ``RecordEvent`` maps
+onto ``jax.profiler.TraceAnnotation`` so user ranges appear inside the
+same timeline; the scheduler state machine and per-step timing summary
+are host-side (identical semantics to the reference's).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last RECORD step of a cycle
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; device tracing is the TPU
+    CUSTOM_DEVICE = 2
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """paddle.profiler.make_scheduler parity: per-step state callable."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
+                          ) -> Callable:
+    """on_trace_ready factory (API parity).  The capture is XPlane/
+    TensorBoard format under ``dir_name`` — open with TensorBoard's
+    profile plugin; a chrome-trace JSON stub with the step table is
+    also written for quick inspection."""
+
+    def handler(prof: "Profiler"):
+        prof._export_dir = dir_name
+        os.makedirs(dir_name, exist_ok=True)
+        steps = [{"name": f"step {i}", "ph": "X", "pid": 0, "tid": 0,
+                  "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
+                 for i, (t0, t1) in enumerate(prof._step_times)]
+        with open(os.path.join(dir_name, "steps.chrome_trace.json"),
+                  "w") as f:
+            json.dump({"traceEvents": steps}, f)
+
+    return handler
+
+
+class RecordEvent:
+    """Host range annotation visible in the device trace
+    (reference: paddle.profiler.RecordEvent over C++ RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        import jax
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class Profiler:
+    """paddle.profiler.Profiler parity over jax.profiler traces.
+
+    Usage (identical shape to the reference):
+        p = Profiler(scheduler=make_scheduler(closed=1, ready=1, record=2),
+                     on_trace_ready=export_chrome_tracing("./prof"))
+        p.start()
+        for batch in loader:
+            train_step(batch)
+            p.step()
+        p.stop()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, trace_dir: str = "./profiler_log"):
+        if scheduler is None:
+            self._schedule = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, (tuple, list)):  # paddle (start, end)
+            lo, hi = scheduler
+            self._schedule = make_scheduler(closed=lo, ready=0,
+                                            record=hi - lo, repeat=1)
+        else:
+            self._schedule = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._trace_dir = trace_dir
+        self._export_dir = trace_dir
+        self.current_state = ProfilerState.CLOSED
+        self._step_num = 0
+        self._tracing = False
+        self._step_times = []
+        self._step_begin = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self._step_num = 0
+        self._apply_state(self._schedule(0))
+        self._step_begin = time.perf_counter()
+        return self
+
+    def stop(self):
+        self._stop_trace()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._step_begin is not None:
+            self._step_times.append((self._step_begin, now))
+        self._step_begin = now
+        self._step_num += 1
+        self._apply_state(self._schedule(self._step_num))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- internals -----------------------------------------------------------
+    def _apply_state(self, state: ProfilerState):
+        recording = state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._tracing and not self._timer_only:
+            self._start_trace()
+        elif not recording and self._tracing:
+            self._stop_trace()
+        self.current_state = state
+
+    def _start_trace(self):
+        import jax
+        os.makedirs(self._trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self._trace_dir)
+        self._tracing = True
+
+    def _stop_trace(self):
+        if self._tracing:
+            import jax
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    # -- summaries -----------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        """Step-timing table (host view; kernel detail lives in the
+        exported XPlane trace)."""
+        if not self._step_times:
+            return "no steps recorded"
+        durs = [(t1 - t0) * 1e3 for t0, t1 in self._step_times]
+        import numpy as np
+        lines = ["step time (ms): "
+                 f"avg={np.mean(durs):.3f} min={np.min(durs):.3f} "
+                 f"max={np.max(durs):.3f} steps={len(durs)}"]
+        return "\n".join(lines)
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)

@@ -41,7 +41,7 @@ class Tensor:
     (paddle semantics); ``Parameter`` flips it to False."""
 
     __slots__ = ("_value", "_stop_gradient", "_grad", "_node", "_out_idx",
-                 "name", "dist_spec", "__weakref__")
+                 "name", "dist_spec", "_hooks", "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -125,8 +125,24 @@ class Tensor:
         return Tensor(self._value, stop_gradient=True)
 
     def register_hook(self, hook):
-        """Gradient hook on this tensor's producing edge (leaf only for now)."""
-        raise NotImplementedError("per-tensor grad hooks land with nn hooks")
+        """Register a gradient hook: ``hook(grad: Tensor) -> Tensor | None``
+        fires on this tensor's accumulated gradient during backward; a
+        non-None return replaces the grad (paddle Tensor.register_hook,
+        fluid/eager hook semantics).  Returns a removable handle."""
+        if self._node is not None:
+            hooks = self._node.out_hooks.setdefault(self._out_idx, [])
+        else:
+            hooks = getattr(self, "_hooks", None)
+            if hooks is None:
+                hooks = []
+                object.__setattr__(self, "_hooks", hooks)
+        hooks.append(hook)
+
+        class _RemoveHelper:
+            def remove(self_inner):
+                if hook in hooks:
+                    hooks.remove(hook)
+        return _RemoveHelper()
 
     # -- value access / mutation -------------------------------------------
     def numpy(self) -> np.ndarray:
@@ -353,9 +369,10 @@ def apply_op(raw_fn, *args, **kwargs):
     diff_idx = [i for i, x in enumerate(leaves)
                 if tape.is_grad_enabled() and _differentiable(x, arrays[i])]
 
+    opname = getattr(raw_fn, "__name__", "op")
     if not diff_idx:
         out = raw_fn(*rebuild(arrays), **kwargs)
-        return _wrap_out(out, node=None)
+        return _wrap_out(out, node=None, opname=opname)
 
     def f(*diff_arrays):
         full = list(arrays)
@@ -377,13 +394,34 @@ def apply_op(raw_fn, *args, **kwargs):
             in_edges.append(("n", src._node, src._out_idx))
         else:
             in_edges.append(("l", src))
-    node = tape.GradNode(getattr(raw_fn, "__name__", "op"), vjp_fn,
-                         in_edges, len(flat), out_tree)
-    return _wrap_out(primal, node=node)
+    node = tape.GradNode(
+        opname, vjp_fn, in_edges, len(flat), out_tree,
+        saved=(raw_fn, tuple(template), dict(kwargs), list(leaves),
+               list(diff_idx), list(arrays)))
+    return _wrap_out(primal, node=node, opname=opname)
 
 
-def _wrap_out(out, node):
+def _check_nan_inf(opname: str, arrays):
+    """FLAGS_check_nan_inf eager scan — the reference's per-op NaN/Inf
+    output check (fluid nan_inf_utils, SURVEY.md §5): reports the FIRST
+    op producing a non-finite output.  Concrete (eager) values only; the
+    compiled path's analog is jax_debug_nans (see jit/train.py)."""
+    from .common.flags import get_flag
+    if not get_flag("check_nan_inf"):
+        return
+    for i, a in enumerate(arrays):
+        if isinstance(a, jax.core.Tracer):
+            return
+        if dtypes.is_floating_point(a.dtype) and not bool(
+                jnp.isfinite(a).all()):
+            raise FloatingPointError(
+                f"FLAGS_check_nan_inf: op '{opname}' output {i} contains "
+                f"NaN/Inf (shape {tuple(a.shape)})")
+
+
+def _wrap_out(out, node, opname="op"):
     flat, treedef = jax.tree_util.tree_flatten(out)
+    _check_nan_inf(opname, flat)
     wrapped = []
     for i, arr in enumerate(flat):
         t = Tensor(arr, stop_gradient=(node is None))

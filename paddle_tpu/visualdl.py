@@ -1,0 +1,66 @@
+"""VisualDL-shaped metric writer.
+
+Reference parity: the VisualDL ``LogWriter`` the reference's hapi
+callbacks log scalars to (SURVEY.md §5 metrics/logging row; VisualDL is
+Paddle's TensorBoard).  TPU-native design: scalars stream to an
+append-only JSONL event file (crash-safe, greppable) and, when the
+installed ``tensorboard`` package exposes a writer, mirror into TB
+event files so the standard TensorBoard UI picks them up next to
+jax.profiler's profile plugin traces.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["LogWriter"]
+
+
+class LogWriter:
+    def __init__(self, logdir: str = "./vdl_log", **kwargs):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._f = open(os.path.join(logdir, "scalars.jsonl"), "a")
+        self._tb = None
+        try:  # optional TensorBoard mirror
+            from tensorboard.summary.writer.event_file_writer import \
+                EventFileWriter
+            from tensorboard.compat.proto.summary_pb2 import Summary
+            from tensorboard.compat.proto.event_pb2 import Event
+            self._tb = EventFileWriter(logdir)
+            self._Summary = Summary
+            self._Event = Event
+        except Exception:
+            pass
+
+    def add_scalar(self, tag: str, value, step: Optional[int] = None,
+                   walltime: Optional[float] = None):
+        wt = walltime if walltime is not None else time.time()
+        rec = {"tag": tag, "value": float(value), "step": step, "time": wt}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        if self._tb is not None:
+            s = self._Summary(
+                value=[self._Summary.Value(tag=tag,
+                                           simple_value=float(value))])
+            self._tb.add_event(self._Event(summary=s, step=step or 0,
+                                           wall_time=wt))
+
+    def flush(self):
+        self._f.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self):
+        self.flush()
+        self._f.close()
+        if self._tb is not None:
+            self._tb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,127 @@
+"""Profiler / nan-check / metric / LogWriter tests (SURVEY.md §5 aux
+subsystems: tracing, sanitizer, metrics/logging)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.common.flags import set_flags
+
+
+class TestProfiler:
+    def test_schedule_state_machine(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sch = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sch(i) for i in range(5)]
+        assert states == [ProfilerState.CLOSED, ProfilerState.READY,
+                          ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN,
+                          ProfilerState.CLOSED]
+
+    def test_smoke_produces_trace_dir(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.profiler import (Profiler, RecordEvent,
+                                         export_chrome_tracing,
+                                         make_scheduler)
+        trace_dir = str(tmp_path / "prof")
+        f = jax.jit(lambda x: jnp.sin(x) @ jnp.cos(x).T)
+        x = jnp.ones((64, 64))
+        p = Profiler(scheduler=make_scheduler(closed=1, ready=1, record=2,
+                                              repeat=1),
+                     on_trace_ready=export_chrome_tracing(trace_dir),
+                     trace_dir=trace_dir)
+        p.start()
+        for _ in range(4):
+            with RecordEvent("train_step"):
+                f(x).block_until_ready()
+            p.step()
+        p.stop()
+        # XPlane capture + the quick chrome step table
+        assert os.path.isdir(trace_dir)
+        names = []
+        for root, _, files in os.walk(trace_dir):
+            names.extend(files)
+        assert "steps.chrome_trace.json" in names
+        assert any(n.endswith(".xplane.pb") for n in names)
+        assert "avg=" in p.summary()
+
+
+class TestNanCheck:
+    def test_eager_flag_catches_injected_inf(self):
+        set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+            with pytest.raises(FloatingPointError, match="log"):
+                paddle.ops.log(x)  # log(-1) = nan
+        finally:
+            set_flags({"FLAGS_check_nan_inf": False})
+        # flag off: silently produces nan (reference behavior)
+        out = paddle.ops.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+        assert np.isnan(out.numpy()).any()
+
+    def test_compiled_path_enables_debug_nans(self):
+        import jax
+        from paddle_tpu.jit.train import CompiledTrainStep
+        from paddle_tpu import nn, optimizer
+        set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            model = nn.Linear(4, 2)
+            opt = optimizer.SGD(learning_rate=0.1)
+            step = CompiledTrainStep(
+                model, lambda m, b: paddle.ops.mean(m(b["x"])), opt)
+            step._build()
+            assert jax.config.jax_debug_nans
+        finally:
+            set_flags({"FLAGS_check_nan_inf": False})
+            jax.config.update("jax_debug_nans", False)
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        from paddle_tpu.metric import Accuracy
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.5, 0.3, 0.2]], np.float32)
+        label = np.array([1, 1])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(0.5)
+        assert top2 == pytest.approx(1.0)
+        m.reset()
+        assert m.count == 0
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric import Precision, Recall
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p = Precision()
+        p.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        r = Recall()
+        r.update(preds, labels)
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect_and_random(self):
+        from paddle_tpu.metric import Auc
+        a = Auc()
+        preds = np.array([0.1, 0.2, 0.8, 0.9])
+        labels = np.array([0, 0, 1, 1])
+        a.update(preds, labels)
+        assert a.accumulate() == pytest.approx(1.0)
+        a.reset()
+        a.update(preds, 1 - labels)
+        assert a.accumulate() == pytest.approx(0.0)
+
+
+class TestLogWriter:
+    def test_scalars_jsonl(self, tmp_path):
+        from paddle_tpu.visualdl import LogWriter
+        with LogWriter(logdir=str(tmp_path / "vdl")) as w:
+            w.add_scalar("loss", 1.5, step=0)
+            w.add_scalar("loss", 1.2, step=1)
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "vdl" / "scalars.jsonl")]
+        assert [l["value"] for l in lines] == [1.5, 1.2]
+        assert [l["step"] for l in lines] == [0, 1]
