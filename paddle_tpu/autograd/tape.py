@@ -148,6 +148,11 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
         w._accumulate_grad(g0)
 
     order = _topo_order(root_node)  # producers first
+    # leaf cotangents are summed across ALL consumer edges first; leaf
+    # hooks then fire ONCE on the final accumulated grad (paddle
+    # register_hook semantics — firing per partial contribution gives
+    # wrong results for any non-linear hook)
+    leaf_cts: dict = {}  # id(leaf) -> [leaf, ct]
     for node in reversed(order):    # consumers first
         node_cts = cts.get(id(node))
         if node_cts is None:
@@ -177,13 +182,24 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
             else:
                 leaf = edge[1]
                 if leaf_filter is None or id(leaf) in leaf_filter:
-                    hooks = getattr(leaf, "_hooks", None)
-                    if hooks:
-                        ct = _run_tensor_hooks(hooks, ct, Tensor)
-                    leaf._accumulate_grad(ct)
+                    ent = leaf_cts.get(id(leaf))
+                    if ent is None:
+                        leaf_cts[id(leaf)] = [leaf, ct]
+                    else:
+                        ent[1] = ent[1] + ct
         if not retain_graph:
             node.vjp_fn = _freed_vjp
+            # drop the saved primals too — keeping every op's inputs
+            # alive after backward pins the whole forward's activations
+            # for as long as the output tensor lives (create_graph reuse
+            # of a freed graph raises anyway, matching vjp_fn above)
+            node.saved = None
         del cts[id(node)]
+    for leaf, ct in leaf_cts.values():
+        hooks = getattr(leaf, "_hooks", None)
+        if hooks:
+            ct = _run_tensor_hooks(hooks, ct, Tensor)
+        leaf._accumulate_grad(ct)
 
 
 def _run_tensor_hooks(hooks, ct, Tensor):

@@ -23,6 +23,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..common.errors import enforce
@@ -44,42 +45,109 @@ def _pvary(x, axis):
 
 @functools.lru_cache(maxsize=64)
 def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
-                     n_params: int, n_extra: int, remat: bool):
+                     n_params: int, n_extra: int, remat: bool,
+                     n_virtual: int, tail_fn: Optional[Callable] = None,
+                     n_tail_params: int = 0, n_tail_idx: int = 0):
     """Build + cache the jitted shard_map engine (keyed on a *stable*
-    stage_fn object so eager loops don't re-trace every step)."""
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    stage_fn object so eager loops don't re-trace every step).
 
-    def inner(params_local, xm, *extra_local):
+    Schedule: circulating pipeline.  With ``n_virtual == 1`` this is
+    GPipe (each device owns one contiguous chunk; microbatch m enters
+    stage 0 at tick m).  With ``n_virtual = v > 1`` it is the
+    interleaved / virtual-stage schedule (Megatron "virtual pipeline"):
+    device d owns chunks d, d+S, …, d+(v-1)·S and microbatches cycle the
+    ring v times in rounds of S, shrinking the fill bubble from
+    (S-1)·T_stage to (S-1)·T_stage/v.
+
+    Output contract — two modes:
+
+    * no ``tail_fn``: each device returns its own [n_micro, …] buffer
+      (only the last stage's is meaningful) with out_specs sharded over
+      ``pp_axis`` — the caller slices the last stage's shard.
+    * ``tail_fn`` (the training path): the loss head runs *inside* the
+      pipeline on each completed microbatch (the reference computes the
+      loss on the last stage — fleet PipelineParallel ``_loss_fn``) and
+      only the accumulated scalars are psum'd over pp.  This removes
+      the round-1 zero-fill + psum of the full [n_micro, batch, …]
+      activation buffer AND never materializes whole-batch logits.
+    """
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    tfn = (jax.checkpoint(tail_fn) if (remat and tail_fn is not None)
+           else tail_fn)
+
+    def inner(params_local, xm, *rest):
+        extra_local = rest[:n_extra]
+        tail_local = rest[n_extra:n_extra + n_tail_params]
+        tail_idx = rest[n_extra + n_tail_params:]
+        # local slab: [1, v, per_chunk, ...] -> [v, per_chunk, ...]
         locals_ = [p[0] for p in params_local]
         n_micro = xm.shape[0]
         stage = jax.lax.axis_index(pp_axis)
         nstage = jax.lax.axis_size(pp_axis)
+        v = n_virtual
+        rounds = -(-n_micro // nstage) if v > 1 else 1
+        total = (rounds * v * nstage + nstage - 1) if v > 1 \
+            else (n_micro + nstage - 1)
         carry = _pvary(jnp.zeros(xm.shape[1:], xm.dtype), pp_axis)
-        outs = _pvary(jnp.zeros(xm.shape, xm.dtype), pp_axis)
+        xmv = _pvary(xm, pp_axis)   # feed index is stage-dependent
+        if tfn is None:
+            acc0 = _pvary(jnp.zeros(xm.shape, xm.dtype), pp_axis)
+        else:
+            shapes = jax.eval_shape(
+                tail_fn, tail_local, xm[0], *(ti[0] for ti in tail_idx))
+            acc0 = jax.tree_util.tree_map(
+                lambda s: _pvary(jnp.zeros(s.shape, s.dtype), pp_axis),
+                shapes)
 
         def step(t, state):
-            carry, outs = state
-            feed = _pvary(xm[jnp.minimum(t, n_micro - 1)], pp_axis)
-            inp = jnp.where(stage == 0, feed, carry)
-            y = fn(locals_, inp, *extra_local)
-            out_idx = jnp.maximum(t - (nstage - 1), 0)
-            keep = jnp.logical_and(stage == nstage - 1,
-                                   t - (nstage - 1) >= 0)
-            upd = jax.lax.dynamic_update_index_in_dim(
-                outs, jnp.where(keep, y, outs[out_idx]), out_idx, 0)
+            carry, acc = state
+            u = t - stage                     # device-local schedule tick
+            if v > 1:
+                uc = jnp.clip(u, 0, rounds * v * nstage - 1)
+                r, uu = uc // (v * nstage), uc % (v * nstage)
+                lap = uu // nstage
+                m = r * nstage + uu % nstage  # microbatch index
+            else:
+                lap = jnp.zeros((), u.dtype)
+                m = jnp.clip(u, 0, n_micro - 1)
+            mc = jnp.minimum(m, n_micro - 1)
+            feed = xmv[mc]
+            inp = jnp.where((stage == 0) & (lap == 0), feed, carry)
+            chunk = [jax.lax.dynamic_index_in_dim(p, lap, 0, False)
+                     for p in locals_]
+            y = fn(chunk, inp, *extra_local)
+            keep = ((stage == nstage - 1) & (u >= 0) & (m < n_micro)
+                    & (lap == v - 1))
+            if tfn is None:
+                acc = jax.lax.dynamic_update_index_in_dim(
+                    acc, jnp.where(keep, y, acc[mc]), mc, 0)
+            else:
+                # the tail runs every tick on every stage and is masked
+                # (SPMD lockstep).  A lax.cond would skip the dead
+                # evaluations, but grad-of-cond inside scan inside
+                # shard_map aborts XLA:CPU (jax 0.9) — and the masked
+                # work rides ticks where non-final stages would
+                # otherwise idle at the next ppermute barrier anyway.
+                tout = tfn(tail_local, y, *(ti[mc] for ti in tail_idx))
+                acc = jax.tree_util.tree_map(
+                    lambda a, o: a + jnp.where(keep, o, jnp.zeros_like(o)),
+                    acc, tout)
             nxt = jax.lax.ppermute(
                 y, pp_axis, [(i, (i + 1) % nstage) for i in range(nstage)])
-            return nxt, upd
+            return nxt, acc
 
-        carry, outs = jax.lax.fori_loop(
-            0, n_micro + nstage - 1, step, (carry, outs))
-        outs = jnp.where(stage == nstage - 1, outs, jnp.zeros_like(outs))
-        return jax.lax.psum(outs, pp_axis)
+        carry, acc = jax.lax.fori_loop(0, total, step, (carry, acc0))
+        if tfn is None:
+            return acc[None]                 # [1, n_micro, ...] per stage
+        # scalars (loss sums/counts): psum over pp is O(1) traffic
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.psum(a, pp_axis), acc)
 
     in_specs = (tuple(P(pp_axis) for _ in range(n_params)), P(),
-                *(P() for _ in range(n_extra)))
+                *(P() for _ in range(n_extra + n_tail_params + n_tail_idx)))
+    out_specs = P() if tail_fn is not None else P(pp_axis)
     mapped = jax.shard_map(inner, mesh=mesh, axis_names={pp_axis},
-                           in_specs=in_specs, out_specs=P())
+                           in_specs=in_specs, out_specs=out_specs)
     # jit wrapper: eager evaluation of checkpoint/scan inside shard_map is
     # unsupported; under an outer jit this inlines
     return jax.jit(mapped)
@@ -87,32 +155,85 @@ def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
 
 def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
                stage_fn: Callable, *extra,
-               mesh, pp_axis: str = "pp", remat: bool = True):
+               mesh, pp_axis: str = "pp", remat: bool = True,
+               n_virtual: int = 1, tail_fn: Optional[Callable] = None,
+               tail_params: Sequence[jax.Array] = (),
+               tail_indexed: Sequence[jax.Array] = ()):
     """Run ``stage_fn`` as a circulating SPMD pipeline.
 
-    params:   arrays stacked [n_stages, ...] (pp-sharded on dim 0);
-              n_stages must equal the ``pp_axis`` mesh size.
+    params:   arrays stacked [n_chunks, ...] in global chunk order,
+              where n_chunks = pp_size * n_virtual; chunk l*S+d is
+              placed on device d as its lap-l virtual stage.
     x_micro:  [n_micro, micro_batch, ...] input microbatches (replicated
               over pp; may be sharded over data axes).
     stage_fn: (local_params_list, h, *extra) -> h, applied by every
               stage.  Pass a STABLE callable (module-level or cached) —
               the compiled engine is cached keyed on it.
     extra:    broadcast side inputs (e.g. rope tables), replicated.
+    n_virtual: virtual stages per device (interleaved schedule).
+    tail_fn:  optional (tail_params, y, *per_micro) -> pytree of arrays;
+              runs on each completed microbatch at the last stage (loss
+              head); results are summed over microbatches.  Must be a
+              STABLE callable, like stage_fn.
+    tail_params: side parameters for tail_fn (e.g. final norm + lm head
+              weights), replicated over pp (mp/dp shardings still apply).
+    tail_indexed: arrays with a leading [n_micro] dim, indexed per
+              microbatch and passed to tail_fn (e.g. labels).
 
-    Returns [n_micro, micro_batch, ...] outputs of the final stage.
+    Returns [n_micro, micro_batch, ...] outputs of the final stage, or
+    the summed tail pytree when ``tail_fn`` is given.
     """
-    n_stages = params[0].shape[0]
-    enforce(n_stages == mesh.shape[pp_axis],
-            f"stacked stage dim {n_stages} != mesh '{pp_axis}' size "
-            f"{mesh.shape[pp_axis]}")
+    nstage = mesh.shape[pp_axis]
+    n_chunks = params[0].shape[0]
+    enforce(n_chunks == nstage * n_virtual,
+            f"stacked chunk dim {n_chunks} != mesh '{pp_axis}' size "
+            f"{nstage} * n_virtual {n_virtual}")
+    # interleaved placement: global chunk order [v*S, ...] -> [S, v, ...]
+    # so dim 0 shards over pp and dim 1 indexes the device's laps
+    stacked = []
+    for p in params:
+        q = p.reshape((n_virtual, nstage) + p.shape[1:])
+        stacked.append(jnp.swapaxes(q, 0, 1))
     fn = _jitted_pipeline(stage_fn, mesh, pp_axis, len(params),
-                          len(extra), remat)
-    return fn(tuple(params), x_micro, *extra)
+                          len(extra), remat, n_virtual, tail_fn,
+                          len(tail_params), len(tail_indexed))
+    out = fn(tuple(stacked), x_micro, *extra, *tail_params, *tail_indexed)
+    if tail_fn is not None:
+        return out
+    return out[nstage - 1]                   # last stage's buffer
 
 
 # ---------------------------------------------------------------------------
 # Paddle-parity layer-list API
 # ---------------------------------------------------------------------------
+
+def _balance_partition(costs: Sequence[int], s: int) -> List[int]:
+    """Contiguous partition of ``costs`` into ``s`` parts minimizing the
+    max part sum (classic DP; n and s are tiny — layer counts)."""
+    n = len(costs)
+    enforce(n >= s, f"cannot split {n} layers into {s} stages")
+    prefix = [0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+    INF = float("inf")
+    # best[k][i] = minimal max-part-sum splitting costs[:i] into k parts
+    best = [[INF] * (n + 1) for _ in range(s + 1)]
+    cut = [[0] * (n + 1) for _ in range(s + 1)]
+    best[0][0] = 0.0
+    for k in range(1, s + 1):
+        for i in range(k, n + 1):
+            for j in range(k - 1, i):
+                val = max(best[k - 1][j], prefix[i] - prefix[j])
+                if val < best[k][i]:
+                    best[k][i] = val
+                    cut[k][i] = j
+    bounds = [n]
+    k, i = s, n
+    while k > 0:
+        i = cut[k][i]
+        bounds.append(i)
+        k -= 1
+    return list(reversed(bounds))
 
 class LayerDesc:
     """Deferred layer constructor (fleet pp_layers.LayerDesc parity)."""
@@ -157,6 +278,7 @@ class PipelineLayer(Layer):
                  recompute_interval: int = 0, **kwargs):
         super().__init__()
         self._loss_fn = loss_fn
+        self._seg_method = seg_method
         self._shared: dict = {}
         built: List[Layer] = []
         self.descs = list(layers)
@@ -182,12 +304,48 @@ class PipelineLayer(Layer):
         self._segment()
 
     def _segment(self):
+        """Compute stage boundaries per ``seg_method`` (fleet
+        PipelineLayer ``seg_method`` parity):
+
+        - ``"uniform"``: equal layer counts per stage;
+        - ``"layer:<Class>"``: stage boundaries only at occurrences of
+          the named layer class (the reference's way of keeping e.g. a
+          decoder block plus its surrounding glue on one stage);
+        - ``"flops"``: balance per-stage cost using parameter count as
+          the FLOPs proxy (for dense layers FLOPs ≈ 2·params·tokens, so
+          param totals rank transformer blocks correctly).
+        """
         n = len(self.run_function)
         s = self._num_stages
-        base, extra = divmod(n, s)
-        bounds = [0]
-        for i in range(s):
-            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        method = self._seg_method or "uniform"
+        if method == "uniform":
+            base, extra = divmod(n, s)
+            bounds = [0]
+            for i in range(s):
+                bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        elif method.startswith("layer:"):
+            name = method[len("layer:"):]
+            marks = [i for i, lyr in enumerate(self.run_function)
+                     if type(lyr).__name__ == name]
+            enforce(len(marks) >= s,
+                    f"seg_method '{method}': found {len(marks)} "
+                    f"'{name}' layers < {s} stages")
+            # first stage starts at 0; later stages begin at evenly
+            # strided marker layers
+            bounds = [0]
+            base, extra = divmod(len(marks), s)
+            idx = 0
+            for i in range(s - 1):
+                idx += base + (1 if i < extra else 0)
+                bounds.append(marks[idx])
+            bounds.append(n)
+        elif method == "flops":
+            costs = [max(1, sum(int(np.prod(p.shape))
+                                for p in lyr.parameters()))
+                     for lyr in self.run_function]
+            bounds = _balance_partition(costs, s)
+        else:
+            enforce(False, f"unknown seg_method '{method}'")
         self.segment_parts = bounds
 
     def get_stage_layers(self, stage: int) -> List[Layer]:

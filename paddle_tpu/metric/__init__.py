@@ -73,12 +73,17 @@ class Accuracy(Metric):
 
     def update(self, correct):
         correct = _to_np(correct)
-        num = correct.shape[0] if correct.ndim else 1
+        # samples = every leading dim (sequence-shaped preds count each
+        # position, matching the paddle metric's prod(shape[:-1]))
+        num = int(np.prod(correct.shape[:-1])) if correct.ndim else 1
+        batch = []
         for i, k in enumerate(self.topk):
-            self.total[i] += float(correct[..., :k].sum())
+            hit = float(correct[..., :k].sum())
+            self.total[i] += hit
+            batch.append(hit / max(num, 1))
         self.count += num
-        res = [t / max(self.count, 1) for t in self.total]
-        return res[0] if len(res) == 1 else res
+        # paddle returns the CURRENT batch accuracy from update()
+        return batch[0] if len(batch) == 1 else batch
 
     def accumulate(self):
         res = [t / max(self.count, 1) for t in self.total]

@@ -426,9 +426,80 @@ def _pipe_stage_fn(n_heads, n_kv, head_dim, eps):
     return stage_fn
 
 
+@functools.lru_cache(maxsize=32)
+def _pipe_tail_fn(eps, transpose_head, ignore_index):
+    """Loss head applied per microbatch on the LAST pipeline stage
+    (reference: fleet PipelineParallel runs _loss_fn on the final stage
+    only) — final RMSNorm + chunked fused linear+CE; returns
+    (loss_sum, valid_token_count) so the engine psums scalars instead
+    of gathering whole-batch activations."""
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+
+    def tail_fn(tail_params, y, labels_mb):
+        norm_w, head_w = tail_params
+        hn = _nn.rms_norm(y, norm_w, epsilon=eps)
+        loss_sum = _nn.fused_linear_cross_entropy(
+            hn, head_w, labels_mb, ignore_index=ignore_index,
+            reduction="sum", transpose_weight=transpose_head)
+        count = jnp.sum((labels_mb != ignore_index).astype(jnp.float32))
+        return loss_sum, count
+
+    return tail_fn
+
+
+def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
+                         n_heads, n_kv, head_dim, eps, num_stages, n_micro,
+                         transpose_head, pp_axis="pp", n_virtual=1,
+                         ignore_index=-100):
+    """Decoder stack + loss head as one SPMD pipeline program; the loss
+    is computed per microbatch on the last stage (raw jax level)."""
+    import jax.numpy as jnp
+
+    from ..distributed.auto_parallel import get_mesh
+    from ..distributed.pipeline import gpipe_spmd
+
+    pm = get_mesh()
+    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps)
+    tail_fn = _pipe_tail_fn(eps, transpose_head, ignore_index)
+    b = x.shape[0]
+    n_layers = params[0].shape[0]
+    n_chunks = (num_stages or 1) * n_virtual
+
+    if b % n_micro:
+        raise ValueError(
+            f"batch size {b} must be divisible by n_microbatches={n_micro}")
+    xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    lm = labels.reshape((n_micro, b // n_micro) + labels.shape[1:])
+
+    pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
+    if num_stages is None:
+        num_stages = pp
+    if pm is None or pp <= 1 or num_stages <= 1:
+        h = stage_fn(list(params), x, cos, sin)
+        loss_sum, count = tail_fn((norm_w, head_w), h,
+                                  labels)
+        return loss_sum / jnp.maximum(count, 1.0)
+
+    n_chunks = num_stages * n_virtual
+    if n_layers % n_chunks:
+        raise ValueError(
+            f"num_hidden_layers={n_layers} must divide evenly over "
+            f"pp_degree={num_stages} * virtual_pp_degree={n_virtual}")
+    per_chunk = n_layers // n_chunks
+    stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
+               for p in params]
+    loss_sum, count = gpipe_spmd(
+        stacked, xm, stage_fn, cos, sin, mesh=pm.mesh, pp_axis=pp_axis,
+        n_virtual=n_virtual, tail_fn=tail_fn,
+        tail_params=(norm_w, head_w), tail_indexed=(lm,))
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
 def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
-                    num_stages, n_micro, pp_axis="pp"):
-    """Decoder stack as an SPMD GPipe pipeline (raw jax level).
+                    num_stages, n_micro, pp_axis="pp", n_virtual=1):
+    """Decoder stack as an SPMD GPipe/interleaved pipeline (raw jax level).
 
     params: 9 stacked arrays, each [L, ...] (order of _decoder_layer_raw).
     """
@@ -449,12 +520,14 @@ def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
         # no pipeline axis: plain scan over layers (single-chip / dp-only)
         return stage_fn(list(params), x, cos, sin)
 
-    if n_layers % num_stages:
+    n_chunks = num_stages * n_virtual
+    if n_layers % n_chunks:
         raise ValueError(
             f"num_hidden_layers={n_layers} must divide evenly over "
-            f"pp_degree={num_stages} stages")
-    per_stage = n_layers // num_stages
-    stacked = [p.reshape((num_stages, per_stage) + p.shape[1:])
+            f"pp_degree={num_stages} * virtual_pp_degree={n_virtual} "
+            f"chunks")
+    per_chunk = n_layers // n_chunks
+    stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
                for p in params]
     b = x.shape[0]
     if b % n_micro:
@@ -463,7 +536,7 @@ def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
     xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
 
     out = gpipe_spmd(stacked, xm, stage_fn, cos, sin,
-                     mesh=pm.mesh, pp_axis=pp_axis)
+                     mesh=pm.mesh, pp_axis=pp_axis, n_virtual=n_virtual)
     return out.reshape(x.shape)
 
 
@@ -476,10 +549,12 @@ class LlamaForCausalLMPipe(Layer):
     pipeline region.  Requires num_hidden_layers % pp_degree == 0.
     """
 
-    def __init__(self, config: LlamaConfig, n_microbatches: int = 4):
+    def __init__(self, config: LlamaConfig, n_microbatches: int = 4,
+                 virtual_pp_degree: int = 1):
         super().__init__()
         self.config = config
         self.n_microbatches = n_microbatches
+        self.virtual_pp_degree = virtual_pp_degree
         c = config
         hd = c.hidden_size // c.num_attention_heads
         self.head_dim = hd
@@ -529,22 +604,30 @@ class LlamaForCausalLMPipe(Layer):
         x = self.embed_tokens(input_ids)
         cos = self.rope_cos[:s]
         sin = self.rope_sin[:s]
+        stack = [self.input_ln, self.q_w, self.k_w, self.v_w, self.o_w,
+                 self.post_ln, self.gate_w, self.up_w, self.down_w]
+        if labels is not None and c.fuse_linear_cross_entropy:
+            # training path: loss head fused into the pipeline's last
+            # stage (scalar psum instead of whole-batch output gather);
+            # fuse_linear_cross_entropy=False falls through to the
+            # gather + unfused-criterion path below
+            tied = self.lm_head is None
+            head_w = (self.embed_tokens.weight if tied
+                      else self.lm_head.weight)
+            return apply_op(
+                _llama_pipe_loss_raw, stack, x, labels, cos, sin,
+                self.norm.weight, head_w,
+                n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,
+                head_dim=self.head_dim, eps=c.rms_norm_eps,
+                num_stages=None, n_micro=self.n_microbatches,
+                transpose_head=tied, n_virtual=self.virtual_pp_degree)
         x = apply_op(
-            _llama_pipe_raw,
-            [self.input_ln, self.q_w, self.k_w, self.v_w, self.o_w,
-             self.post_ln, self.gate_w, self.up_w, self.down_w],
-            x, cos, sin,
+            _llama_pipe_raw, stack, x, cos, sin,
             n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,
             head_dim=self.head_dim, eps=c.rms_norm_eps,
-            num_stages=None, n_micro=self.n_microbatches)
+            num_stages=None, n_micro=self.n_microbatches,
+            n_virtual=self.virtual_pp_degree)
         x = self.norm(x)
-        if labels is not None and c.fuse_linear_cross_entropy:
-            if self.lm_head is None:
-                return F.fused_linear_cross_entropy(
-                    x, self.embed_tokens.weight, labels,
-                    transpose_weight=True)
-            return F.fused_linear_cross_entropy(
-                x, self.lm_head.weight, labels)
         if self.lm_head is None:
             logits = P.matmul(x, self.embed_tokens.weight, transpose_y=True)
         else:

@@ -298,16 +298,27 @@ def _conv_padding(padding, n, stride, dilation, ksize):
 
 def _match_conv_dtypes(x, weight):
     """amp O2 contract: a low-precision conv weight pulls the input down
-    to its dtype (lax.conv requires equal dtypes; f32 accumulate below)."""
+    to its dtype (lax.conv requires equal dtypes).  bf16 runs natively
+    (the MXU accumulates partial products in f32 internally); float16
+    has no safe accumulator on TPU, so fp16 convs run in f32 and cast
+    back — same numerics as f32 accumulation, and the autodiff
+    transpose stays single-dtype (an explicit preferred_element_type
+    trips it on mixed bf16-primal/f32-cotangent operands).
+
+    Returns (x, weight, out_dtype); cast the conv output to out_dtype.
+    """
     if x.dtype != weight.dtype:
         x = x.astype(weight.dtype)
-    return x
+    if x.dtype == jnp.float16:
+        return x.astype(jnp.float32), weight.astype(jnp.float32), \
+            jnp.float16
+    return x, weight, None
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     """paddle F.conv2d: weight [C_out, C_in/groups, kH, kW]."""
-    x = _match_conv_dtypes(x, weight)
+    x, weight, out_dt = _match_conv_dtypes(x, weight)
     n = 2
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -324,6 +335,8 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if out_dt is not None:
+        out = out.astype(out_dt)
     if bias is not None:
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         out = out + bias.reshape(bshape)
@@ -349,7 +362,7 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
-    x = _match_conv_dtypes(x, weight)
+    x, weight, out_dt = _match_conv_dtypes(x, weight)
     n = 3
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -360,6 +373,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if out_dt is not None:
+        out = out.astype(out_dt)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1, 1)
     return out
@@ -369,7 +384,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCHW"):
     """weight [C_in, C_out/groups, kH, kW] (paddle conv_transpose layout)."""
-    x = _match_conv_dtypes(x, weight)
+    x, weight, out_dt = _match_conv_dtypes(x, weight)
     n = 2
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
@@ -398,6 +413,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         x, w, window_strides=(1, 1), padding=pad_trans,
         lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if out_dt is not None:
+        out = out.astype(out_dt)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
@@ -628,16 +645,19 @@ def fused_linear_cross_entropy(x, weight, label, bias=None,
         per_tok = jnp.where(valid, lse - tgt, 0.0)
         return per_tok, valid.astype(jnp.float32)
 
+    # accumulate via stacked scan OUTPUTS (empty carry): a carry would
+    # need its varying-manual-axes type to match the body's, which breaks
+    # when this runs inside a shard_map region (the pipeline loss tail)
     def body(carry, inp):
         per_tok, valid = chunk_loss(*inp)
         if reduction == "none":
             return carry, per_tok
-        return (carry[0] + jnp.sum(per_tok), carry[1] + jnp.sum(valid)), None
+        return carry, (jnp.sum(per_tok), jnp.sum(valid))
 
+    _, ys = jax.lax.scan(body, (), (xc_all, lab_all))
     if reduction == "none":
-        _, per = jax.lax.scan(body, (0.0, 0.0), (xc_all, lab_all))
-        return per.reshape(-1)[:n].reshape(label.shape)
-    (total, count), _ = jax.lax.scan(body, (0.0, 0.0), (xc_all, lab_all))
+        return ys.reshape(-1)[:n].reshape(label.shape)
+    total, count = jnp.sum(ys[0]), jnp.sum(ys[1])
     if reduction == "sum":
         return total
     return total / jnp.maximum(count, 1.0)

@@ -77,6 +77,9 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
                   "w") as f:
             json.dump({"traceEvents": steps}, f)
 
+    # the Profiler reads this to keep the XPlane capture and the step
+    # table in ONE directory when the user only passes on_trace_ready
+    handler._export_dir = dir_name
     return handler
 
 
@@ -121,7 +124,8 @@ class Profiler:
 
     def __init__(self, *, targets: Optional[Iterable] = None,
                  scheduler=None, on_trace_ready: Optional[Callable] = None,
-                 timer_only: bool = False, trace_dir: str = "./profiler_log"):
+                 timer_only: bool = False,
+                 trace_dir: Optional[str] = None):
         if scheduler is None:
             self._schedule = lambda step: ProfilerState.RECORD
         elif isinstance(scheduler, (tuple, list)):  # paddle (start, end)
@@ -132,6 +136,12 @@ class Profiler:
             self._schedule = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        if trace_dir is None:
+            # keep the XPlane capture next to the handler's export so
+            # `on_trace_ready=export_chrome_tracing(dir)` puts the whole
+            # profile in ONE directory (as the docstring usage promises)
+            trace_dir = getattr(on_trace_ready, "_export_dir",
+                                "./profiler_log")
         self._trace_dir = trace_dir
         self._export_dir = trace_dir
         self.current_state = ProfilerState.CLOSED
