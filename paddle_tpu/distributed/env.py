@@ -11,6 +11,37 @@ import os
 
 import jax
 
+_PARALLEL_ENV_READY = False
+
+
+def init_parallel_env() -> bool:
+    """paddle.distributed.init_parallel_env parity: join the multi-host
+    runtime when the launch env is present.
+
+    The launch controller (distributed/launch) seeds PADDLE_MASTER /
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM; this calls
+    ``jax.distributed.initialize`` (jax's coordination service = the
+    reference's TCPStore rendezvous, SURVEY.md §2.4) so every process
+    sees the GLOBAL device set and one mesh spans all hosts.  No-op
+    when single-process or already initialized.  Must run before first
+    device use.  Returns True when a multi-process runtime is active.
+    """
+    global _PARALLEL_ENV_READY
+    if _PARALLEL_ENV_READY:
+        return True    # latched only after an actual initialize()
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master = os.environ.get("PADDLE_MASTER")
+    if n > 1 and master:
+        jax.distributed.initialize(
+            coordinator_address=master, num_processes=n,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        _PARALLEL_ENV_READY = True
+        return True
+    # no launch env (single process, or a TPU pod slice where jax will
+    # discover topology itself): not a joined runtime — do NOT latch,
+    # so a later call made after the env is seeded can still join
+    return False
+
 
 def get_rank() -> int:
     if "PADDLE_TRAINER_ID" in os.environ:

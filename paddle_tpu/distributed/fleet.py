@@ -48,6 +48,9 @@ def init(role_maker=None, is_collective: bool = True,
          strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
     """fleet.init — build the device mesh from strategy.hybrid_configs."""
     global _HCG, _STRATEGY
+    # join the multi-host runtime first (no-op single-process): the mesh
+    # below must span the GLOBAL device set
+    dist_env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
     _STRATEGY = strategy
     hybrid = strategy.hybrid

@@ -1,0 +1,5 @@
+from .controller import Controller, LaunchConfig, free_port
+from .main import launch, parse_args
+
+__all__ = ["Controller", "LaunchConfig", "free_port", "launch",
+           "parse_args"]

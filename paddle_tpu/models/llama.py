@@ -465,22 +465,22 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     tail_fn = _pipe_tail_fn(eps, transpose_head, ignore_index)
     b = x.shape[0]
     n_layers = params[0].shape[0]
-    n_chunks = (num_stages or 1) * n_virtual
+
+    pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
+    if num_stages is None:
+        num_stages = pp
+    if pm is None or pp <= 1 or num_stages <= 1:
+        # serial fallback never microbatches — no divisibility demands
+        h = stage_fn(list(params), x, cos, sin)
+        loss_sum, count = tail_fn((norm_w, head_w), h,
+                                  labels)
+        return loss_sum / jnp.maximum(count, 1.0)
 
     if b % n_micro:
         raise ValueError(
             f"batch size {b} must be divisible by n_microbatches={n_micro}")
     xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
     lm = labels.reshape((n_micro, b // n_micro) + labels.shape[1:])
-
-    pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
-    if num_stages is None:
-        num_stages = pp
-    if pm is None or pp <= 1 or num_stages <= 1:
-        h = stage_fn(list(params), x, cos, sin)
-        loss_sum, count = tail_fn((norm_w, head_w), h,
-                                  labels)
-        return loss_sum / jnp.maximum(count, 1.0)
 
     n_chunks = num_stages * n_virtual
     if n_layers % n_chunks:

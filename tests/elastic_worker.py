@@ -1,0 +1,59 @@
+"""Trainer script for the elastic-recovery test: trains 6 steps with a
+per-step checkpoint; on its first life (when told to crash) it dies at
+step 3, and the relaunched life resumes from the latest checkpoint —
+the reference elastic manager's checkpoint-based recovery contract
+(SURVEY.md §5 failure detection / fleet elastic)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main(out_dir, crash):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit.train import CompiledTrainStep
+
+    paddle.seed(42)
+    model = nn.Linear(8, 8)
+    opt = optimizer.AdamW(learning_rate=1e-2,
+                          parameters=model.parameters())
+    crit = nn.MSELoss()
+    step = CompiledTrainStep(
+        model, lambda m, b: crit(m(b["x"]), b["y"]), opt, seed=0)
+
+    ckpt = os.path.join(out_dir, "ckpt")
+    step_file = os.path.join(out_dir, "steps_done")
+    start = 0
+    if os.path.exists(step_file):
+        start = int(open(step_file).read())
+        step.load_checkpoint(ckpt)
+
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(4, 8)).astype(np.float32),
+                "y": rng.normal(size=(4, 8)).astype(np.float32)}
+               for _ in range(6)]
+
+    marker = os.path.join(out_dir, "crashed_once")
+    loss = None
+    for i in range(start, 6):
+        loss = float(np.asarray(jax.device_get(step(batches[i]))))
+        step.save_checkpoint(ckpt)
+        with open(step_file, "w") as f:
+            f.write(str(i + 1))
+        if crash and i == 2 and not os.path.exists(marker):
+            open(marker, "w").write("x")
+            os._exit(1)
+
+    with open(os.path.join(out_dir, "final_loss.txt"), "w") as f:
+        f.write(repr(loss))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2] == "1")

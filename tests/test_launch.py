@@ -1,0 +1,105 @@
+"""Multi-host rendezvous: the launch controller + jax.distributed
+coordination service (reference: paddle.distributed.launch + TCPStore,
+SURVEY.md §1 L9 / §2.4).  Two real OS processes on one machine — the
+reference's single-host multi-proc simulation of multi-node."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the pytest session pins an 8-device cpu platform; workers set
+    # their own 4-device env, so drop the session's overrides
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            env.pop(k)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_rendezvous(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         os.path.join(REPO, "tests", "launch_worker.py"), str(tmp_path)],
+        env=_clean_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=240)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.exists():
+        for f in sorted(logdir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    assert out.returncode == 0, f"launch failed: {out.stderr}\n{logs}"
+    result = (tmp_path / "result.txt").read_text()
+    assert "psum=28.0" in result and "world=2" in result, result
+
+
+def test_launch_elastic_relaunches_failed_gang(tmp_path):
+    """elastic_level=1: a worker that crashes on its first life exits 0
+    after the controller relaunches the gang (checkpoint-based recovery
+    contract, SURVEY.md §5 failure detection)."""
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "crashed_once"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(repr(str(marker)))}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x')\n"
+        "    sys.exit(1)\n"
+        "sys.exit(0)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_level", "1",
+         "--max_restarts", "2", str(script)],
+        env=_clean_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "relaunching" in out.stderr
+
+
+def test_launch_fail_fast_propagates_exit_code(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        env=_clean_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert out.returncode == 7
+
+
+def test_elastic_crash_resume_matches_uninterrupted(tmp_path):
+    """End-to-end elastic recovery: a trainer that dies at step 3 is
+    relaunched by the controller, resumes from its checkpoint, and its
+    final loss matches an uninterrupted run exactly."""
+    worker = os.path.join(REPO, "tests", "elastic_worker.py")
+    crash_dir = tmp_path / "crash"
+    clean_dir = tmp_path / "clean"
+    crash_dir.mkdir(), clean_dir.mkdir()
+
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--elastic_level", "1",
+         "--max_restarts", "2", worker, str(crash_dir), "1"],
+        env=_clean_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "relaunching" in out.stderr  # it really did die once
+    assert (crash_dir / "crashed_once").exists()
+
+    out2 = subprocess.run(
+        [sys.executable, worker, str(clean_dir), "0"],
+        env=_clean_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=240)
+    assert out2.returncode == 0, out2.stderr
+
+    crashed = (crash_dir / "final_loss.txt").read_text()
+    clean = (clean_dir / "final_loss.txt").read_text()
+    assert crashed == clean, (crashed, clean)
