@@ -35,6 +35,9 @@ from .framework.io import load, save
 from . import metric
 from . import profiler
 from . import visualdl
+from . import hapi
+from .hapi import Model
+from .hapi import callbacks
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

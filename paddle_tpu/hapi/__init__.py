@@ -1,0 +1,7 @@
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, VisualDL)
+from .model import Model
+
+__all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "EarlyStopping", "LRScheduler", "VisualDL"]

@@ -1,0 +1,323 @@
+"""paddle.Model — the hapi high-level trainer.
+
+Reference parity: python/paddle/hapi/model.py (SURVEY.md §2.2 hapi row):
+``Model(network).prepare(optimizer, loss, metrics)`` then
+``fit/evaluate/predict/save/load`` with the callbacks protocol.
+
+TPU-native design: ``fit`` drives ONE compiled XLA step
+(jit/train.CompiledTrainStep — fwd+bwd+clip+update fused, params live on
+device) instead of the reference's per-op dygraph loop; eval/predict are
+compile-once jitted forwards.  Metrics consume per-batch predictions on
+host, matching paddle.metric semantics.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.errors import enforce
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_host(x):
+    import jax
+    return np.asarray(jax.device_get(x))
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._eval_jits = {}
+        self._pending_opt_state = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None:
+            enforce(callable(loss), "loss must be callable (a Layer or fn)")
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            enforce(isinstance(m, Metric),
+                    f"metrics must be paddle_tpu.metric.Metric, got "
+                    f"{type(m)}")
+        return self
+
+    def _loss_fn(self, net, batch):
+        ins, labs = batch["inputs"], batch["labels"]
+        out = net(*ins)
+        outs = _as_list(out)
+        return self._loss(*(outs + list(labs)))
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            enforce(self._optimizer is not None and self._loss is not None,
+                    "call prepare(optimizer=..., loss=...) before training")
+            from ..jit.train import CompiledTrainStep
+            self.network.train()
+            self._train_step = CompiledTrainStep(
+                self.network, self._loss_fn, self._optimizer)
+            if self._pending_opt_state is not None:
+                self._train_step.state["opt"] = self._pending_opt_state
+                self._pending_opt_state = None
+        return self._train_step
+
+    def _params(self):
+        """Current params pytree: the train step's device state when it
+        exists, else the network's own."""
+        if self._train_step is not None:
+            return self._train_step.state["params"]
+        return self.network.raw_state_dict()
+
+    def _run_eval(self, name: str, fn: Callable, batch):
+        """Compile-once jitted forward independent of the train step —
+        predict/evaluate must work with no optimizer (inference-only
+        Model, paddle parity) and never allocate optimizer state.  The
+        network is traced in eval mode (dropout off, BN running stats)."""
+        import jax
+
+        from ..autograd import tape
+        from ..nn.layer import functional_state
+        from ..ops import random as _random
+
+        jitted = self._eval_jits.get(name)
+        if jitted is None:
+            net = self.network
+
+            def run(params, batch, key):
+                batch_t = jax.tree_util.tree_map(
+                    lambda a: Tensor(a, stop_gradient=True), batch)
+                with tape.no_grad(), functional_state(net, params), \
+                        _random.rng_guard(key):
+                    out = fn(net, batch_t)
+                return jax.tree_util.tree_map(
+                    lambda x: x.value if isinstance(x, Tensor) else x, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+
+            jitted = jax.jit(run)
+            self._eval_jits[name] = jitted
+        import jax.numpy as jnp
+        was_training = self.network.training
+        self.network.eval()
+        try:
+            batch_arr = jax.tree_util.tree_map(
+                lambda x: x.value if isinstance(x, Tensor) else jnp.asarray(x),
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
+            return jitted(self._params(), batch_arr, jax.random.key(0))
+        finally:
+            if was_training:
+                self.network.train()
+
+    # -- batch-level API ------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        step = self._ensure_train_step()
+        batch = {"inputs": tuple(_as_list(inputs)),
+                 "labels": tuple(_as_list(labels))}
+        loss = step(batch)
+        return [_to_host(loss)]
+
+    def _eval_fn(self, net, batch):
+        ins, labs = batch["inputs"], batch["labels"]
+        out = net(*ins)
+        outs = _as_list(out)
+        res = {"preds": outs}
+        if self._loss is not None and labs:
+            res["loss"] = self._loss(*(outs + list(labs)))
+        return res
+
+    def _predict_fn(self, net, batch):
+        return _as_list(net(*batch["inputs"]))
+
+    def eval_batch(self, inputs, labels=None):
+        batch = {"inputs": tuple(_as_list(inputs)),
+                 "labels": tuple(_as_list(labels))}
+        return self._run_eval("eval", self._eval_fn, batch)
+
+    def predict_batch(self, inputs):
+        batch = {"inputs": tuple(_as_list(inputs)), "labels": ()}
+        return [_to_host(p)
+                for p in self._run_eval("predict", self._predict_fn, batch)]
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers,
+                drop_last=False):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        """DataLoader batches arrive as [x] or [x, y] (or a longer list:
+        the LAST item is the label, paddle's single-label convention)."""
+        items = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(items) == 1:
+            return items, []
+        return items[:-1], items[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
+        enforce(loader is not None, "fit needs train_data")
+        self._ensure_train_step()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, batch_size=batch_size,
+                                verbose=verbose, log_freq=log_freq,
+                                save_dir=save_dir,
+                                save_freq=save_freq,
+                                metrics=[n for m in self._metrics
+                                         for n in _as_list(m.name())])
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step_i, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step_i)
+                ins, labs = self._split_batch(batch)
+                logs = {"loss": self.train_batch(ins, labs)[0]}
+                if self._metrics:
+                    ev = self.eval_batch(ins, labs)
+                    logs.update(self._update_metrics(ev, labs))
+                cbks.on_train_batch_end(step_i, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, num_workers=num_workers,
+                              callbacks=cbks)
+        cbks.on_train_end(logs)
+        return self
+
+    def _update_metrics(self, ev, labs):
+        out = {}
+        if "loss" in ev:
+            out["loss"] = _to_host(ev["loss"])
+        preds = ev["preds"]
+        for m in self._metrics:
+            r = m.compute(*(list(preds) + [Tensor(l) if not isinstance(
+                l, Tensor) else l for l in labs]))
+            # default compute() passes through an args tuple; update
+            # takes them positionally (paddle Metric protocol)
+            vals = _as_list(m.update(*[_to_host(x) for x in _as_list(r)]))
+            out.update(dict(zip(_as_list(m.name()), vals)))
+        return out
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks if callbacks is not None else config_callbacks(
+            None, model=self, verbose=verbose, log_freq=log_freq,
+            metrics=[n for m in self._metrics for n in _as_list(m.name())])
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step_i, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step_i)
+            ins, labs = self._split_batch(batch)
+            ev = self.eval_batch(ins, labs)
+            logs = self._update_metrics(ev, labs)
+            if "loss" in logs:
+                losses.append(float(np.asarray(logs["loss"])))
+            cbks.on_eval_batch_end(step_i, logs)
+        result = {}
+        if losses:
+            result["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            vals = _as_list(m.accumulate())
+            result.update(dict(zip(_as_list(m.name()), vals)))
+        cbks.on_eval_end(result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=0)
+        cbks.on_predict_begin()
+        outs: List[List[np.ndarray]] = []
+        for step_i, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step_i)
+            ins, _ = self._split_batch(batch)
+            preds = self.predict_batch(ins)
+            outs.append(preds)
+            cbks.on_predict_batch_end(step_i)
+        cbks.on_predict_end()
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[b[i] for b in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        """path.pdparams (+ path.pdopt when training=True), paddle layout."""
+        from ..framework.io import save
+        self._sync_from_step()
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._train_step is not None:
+            save(self._train_step.state["opt"], path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        from ..framework.io import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        opt_state = None
+        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+            opt_state = load(path + ".pdopt")
+        if self._train_step is not None:
+            self._train_step.sync_from_model()
+            if opt_state is not None:
+                self._train_step.state["opt"] = opt_state
+        else:
+            # train step is built lazily: apply on first _ensure_train_step
+            self._pending_opt_state = opt_state
+        return self
+
+    def _sync_from_step(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            lines.append(f"  {name:50s} {str(tuple(p.shape)):20s} {n}")
+        out = "\n".join(["-" * 80] + lines +
+                        ["-" * 80, f"Total params: {total}"])
+        print(out)
+        return {"total_params": total}

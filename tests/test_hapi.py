@@ -1,0 +1,179 @@
+"""hapi Model.fit/evaluate/predict (reference: python/paddle/hapi —
+SURVEY.md §2.2): high-level trainer over the compiled step."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.hapi import (EarlyStopping, Model, ModelCheckpoint,
+                             ProgBarLogger)
+
+
+class XorDataset(Dataset):
+    """Tiny classification set a 2-layer MLP must learn."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, 4)).astype(np.float32)
+        w = rng.normal(size=(4,)).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+def _model():
+    m = Model(_mlp())
+    m.prepare(optimizer=optimizer.AdamW(
+                  learning_rate=1e-2, parameters=m.parameters()),
+              loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    return m
+
+
+def test_fit_learns_and_reports_metrics(capsys):
+    m = _model()
+    m.fit(XorDataset(), batch_size=32, epochs=8, verbose=0)
+    res = m.evaluate(XorDataset(seed=0), batch_size=32, verbose=0)
+    assert res["acc"] > 0.9, res
+    assert res["loss"] < 0.5, res
+
+
+def test_evaluate_and_predict_shapes():
+    m = _model()
+    m.fit(XorDataset(), batch_size=32, epochs=1, verbose=0)
+    preds = m.predict(XorDataset(n=48), batch_size=16, stack_outputs=True)
+    assert len(preds) == 1 and preds[0].shape == (48, 2)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = _model()
+    data = XorDataset()
+    m.fit(data, batch_size=32, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    m.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    m2 = _model()
+    m2.load(path)
+    p1 = m.predict(XorDataset(n=16), batch_size=16, stack_outputs=True)[0]
+    p2 = m2.predict(XorDataset(n=16), batch_size=16, stack_outputs=True)[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_early_stopping_stops():
+    m = _model()
+    stopper = EarlyStopping(monitor="loss", patience=0, verbose=0,
+                            save_best_model=False, baseline=0.0)
+    m.fit(XorDataset(), eval_data=XorDataset(seed=1), batch_size=32,
+          epochs=10, verbose=0, callbacks=[stopper])
+    assert m.stop_training
+
+
+def test_model_checkpoint_writes(tmp_path):
+    m = _model()
+    m.fit(XorDataset(), batch_size=64, epochs=2, verbose=0,
+          save_dir=str(tmp_path))
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+
+
+def test_train_batch_eval_batch_api():
+    m = _model()
+    d = XorDataset(n=8)
+    loss1 = m.train_batch([d.x], [d.y])[0]
+    loss2 = m.train_batch([d.x], [d.y])[0]
+    assert float(loss2) < float(loss1)
+    ev = m.eval_batch([d.x], [d.y])
+    assert "loss" in ev and ev["preds"][0].shape == (8, 2)
+
+
+def test_summary_counts_params(capsys):
+    m = _model()
+    info = m.summary()
+    assert info["total_params"] == 4 * 32 + 32 + 32 * 2 + 2
+
+
+def test_predict_without_optimizer():
+    """Inference-only Model: prepare() with no optimizer/loss must still
+    predict (and never allocate optimizer state)."""
+    net = _mlp()
+    m = Model(net)
+    m.prepare()
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    preds = m.predict_batch([x])
+    assert preds[0].shape == (8, 2)
+    assert m._train_step is None
+
+
+def test_eval_runs_in_eval_mode():
+    """Dropout must be OFF in evaluate/predict: two predict calls agree
+    bit-for-bit even with a dropout layer."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 32), nn.Dropout(0.5), nn.Linear(32, 2))
+    m = Model(net)
+    m.prepare()
+    x = np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32)
+    p1 = m.predict_batch([x])[0]
+    p2 = m.predict_batch([x])[0]
+    np.testing.assert_array_equal(p1, p2)
+    assert not np.all(p1 == 0)
+
+
+def test_precision_metric_protocol():
+    """Metrics using the DEFAULT compute() (args pass-through) must work:
+    update() receives (pred, label) positionally."""
+    from paddle_tpu.metric import Precision
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1),
+                        nn.Sigmoid())
+    m = Model(net)
+    m.prepare(optimizer=optimizer.AdamW(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+              loss=nn.BCELoss(), metrics=Precision())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x[:, :1] > 0).astype(np.float32)
+
+    class D(Dataset):
+        def __getitem__(self, i):
+            return x[i], y[i]
+
+        def __len__(self):
+            return 64
+
+    res = m.evaluate(D(), batch_size=32, verbose=0)
+    assert "precision" in res
+
+
+def test_load_before_train_step_restores_opt(tmp_path):
+    m = _model()
+    m.fit(XorDataset(), batch_size=32, epochs=1, verbose=0)
+    path = str(tmp_path / "m")
+    m.save(path)
+
+    m2 = _model()          # fresh, train step NOT built yet
+    m2.load(path)
+    assert m2._pending_opt_state is not None
+    m2._ensure_train_step()
+    assert m2._pending_opt_state is None
+    # moments restored, not zeros: dig out any adam moment leaf
+    import jax
+    leaves = jax.tree_util.tree_leaves(m2._train_step.state["opt"])
+    assert any(np.any(np.asarray(jax.device_get(l)) != 0)
+               for l in leaves if hasattr(l, "shape"))
