@@ -38,6 +38,7 @@ from . import visualdl
 from . import hapi
 from .hapi import Model
 from .hapi import callbacks
+from . import inference
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

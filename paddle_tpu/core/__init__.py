@@ -1,0 +1,13 @@
+"""Native (C++) runtime components.
+
+Reference parity: the reference's native core is C++ behind pybind11
+(SURVEY.md §1); the TPU build keeps XLA as the compute engine and
+implements the RUNTIME pieces natively where the reference's are —
+rendezvous store (tcp_store.cpp), data-reader core (dataio.cpp) —
+compiled on first use with the system toolchain and loaded via ctypes
+(pybind11 is not in this image).  Every consumer has a pure-python
+fallback so the package still works without a compiler.
+"""
+from .build import load_native
+
+__all__ = ["load_native"]

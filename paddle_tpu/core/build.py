@@ -1,0 +1,117 @@
+"""First-use native build: csrc/*.cpp -> _lib/libpaddle_tpu_native.so.
+
+Cached by source content hash; rebuilds only when sources change.
+Returns None (callers fall back to python) when no toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "csrc")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cpp"))
+
+
+def _digest(files) -> str:
+    h = hashlib.sha256()
+    for f in files:
+        with open(f, "rb") as fp:
+            h.update(fp.read())
+    return h.hexdigest()[:16]
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Compile-once loader for the native runtime library."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        srcs = _sources()
+        if not srcs:
+            return None
+        tag = _digest(srcs)
+        so = os.path.join(_LIB_DIR, f"libpaddle_tpu_native-{tag}.so")
+        if not os.path.exists(so):
+            gxx = shutil.which("g++") or shutil.which("c++")
+            if gxx is None:
+                return None
+            os.makedirs(_LIB_DIR, exist_ok=True)
+            tmp = so + f".tmp{os.getpid()}"
+            cmd = [gxx, "-O2", "-fPIC", "-shared", "-pthread",
+                   "-std=c++17", "-o", tmp] + srcs
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=240)
+                os.replace(tmp, so)   # atomic: concurrent builds race safely
+            except (subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired) as e:
+                err = getattr(e, "stderr", b"") or b""
+                import warnings
+                warnings.warn(
+                    f"native build failed, using python fallbacks: "
+                    f"{err.decode(errors='replace')[-500:]}")
+                return None
+        try:
+            _LIB = ctypes.CDLL(so)
+        except OSError:
+            return None
+        _configure(_LIB)
+        return _LIB
+
+
+def _configure(lib: ctypes.CDLL):
+    c = ctypes
+    lib.tcp_store_server_start.restype = c.c_void_p
+    lib.tcp_store_server_start.argtypes = [c.c_char_p, c.c_int,
+                                           c.POINTER(c.c_int)]
+    lib.tcp_store_server_stop.argtypes = [c.c_void_p]
+    lib.tcp_store_connect.restype = c.c_int
+    lib.tcp_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.tcp_store_close.argtypes = [c.c_int]
+    lib.tcp_store_set.restype = c.c_int
+    lib.tcp_store_set.argtypes = [c.c_int, c.c_char_p, c.c_int,
+                                  c.c_char_p, c.c_uint64]
+    lib.tcp_store_get.restype = c.c_int
+    lib.tcp_store_get.argtypes = [c.c_int, c.c_char_p, c.c_int,
+                                  c.c_uint64,
+                                  c.POINTER(c.POINTER(c.c_char)),
+                                  c.POINTER(c.c_uint64)]
+    lib.tcp_store_add.restype = c.c_int
+    lib.tcp_store_add.argtypes = [c.c_int, c.c_char_p, c.c_int,
+                                  c.c_int64, c.POINTER(c.c_int64)]
+    lib.tcp_store_wait.restype = c.c_int
+    lib.tcp_store_wait.argtypes = [c.c_int, c.c_char_p, c.c_int,
+                                   c.c_uint64]
+    lib.tcp_store_delete.restype = c.c_int
+    lib.tcp_store_delete.argtypes = [c.c_int, c.c_char_p, c.c_int]
+    lib.tcp_store_check.restype = c.c_int
+    lib.tcp_store_check.argtypes = [c.c_int, c.c_char_p, c.c_int,
+                                    c.POINTER(c.c_int)]
+    lib.tcp_store_free.argtypes = [c.POINTER(c.c_char)]
+
+    lib.dataio_open.restype = c.c_void_p
+    lib.dataio_open.argtypes = [c.c_char_p, c.c_int, c.c_int64, c.c_int64,
+                                c.c_int, c.c_int64]
+    lib.dataio_num_batches.restype = c.c_int64
+    lib.dataio_num_batches.argtypes = [c.c_void_p]
+    lib.dataio_num_seqs.restype = c.c_int64
+    lib.dataio_num_seqs.argtypes = [c.c_void_p]
+    lib.dataio_next.restype = c.c_int64
+    lib.dataio_next.argtypes = [c.c_void_p, c.c_void_p]
+    lib.dataio_close.argtypes = [c.c_void_p]

@@ -1,5 +1,6 @@
 """paddle.distributed surface: fleet, collectives, auto-parallel, sharding."""
 from . import env
+from .store import TCPStore
 from . import auto_parallel
 from . import checkpoint
 from . import collective

@@ -48,6 +48,7 @@ class Model:
         self._train_step = None
         self._eval_jits = {}
         self._pending_opt_state = None
+        self._accum_grads = None
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------------
@@ -97,23 +98,13 @@ class Model:
         network is traced in eval mode (dropout off, BN running stats)."""
         import jax
 
-        from ..autograd import tape
-        from ..nn.layer import functional_state
-        from ..ops import random as _random
-
         jitted = self._eval_jits.get(name)
         if jitted is None:
+            from ..jit.train import traced_forward
             net = self.network
 
             def run(params, batch, key):
-                batch_t = jax.tree_util.tree_map(
-                    lambda a: Tensor(a, stop_gradient=True), batch)
-                with tape.no_grad(), functional_state(net, params), \
-                        _random.rng_guard(key):
-                    out = fn(net, batch_t)
-                return jax.tree_util.tree_map(
-                    lambda x: x.value if isinstance(x, Tensor) else x, out,
-                    is_leaf=lambda x: isinstance(x, Tensor))
+                return traced_forward(net, fn, params, batch, key)
 
             jitted = jax.jit(run)
             self._eval_jits[name] = jitted
@@ -134,7 +125,19 @@ class Model:
         step = self._ensure_train_step()
         batch = {"inputs": tuple(_as_list(inputs)),
                  "labels": tuple(_as_list(labels))}
-        loss = step(batch)
+        if update and self._accum_grads is None:
+            return [_to_host(step(batch))]     # fused fast path
+        # paddle update=False semantics: accumulate grads, defer update
+        import jax
+        loss, grads = step.grad_step(batch)
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = jax.tree_util.tree_map(
+                lambda a, g: a + g, self._accum_grads, grads)
+        if update:
+            step.apply_grads(self._accum_grads)
+            self._accum_grads = None
         return [_to_host(loss)]
 
     def _eval_fn(self, net, batch):
@@ -206,8 +209,13 @@ class Model:
                 ins, labs = self._split_batch(batch)
                 logs = {"loss": self.train_batch(ins, labs)[0]}
                 if self._metrics:
+                    # metrics cost a second jitted forward (the fused
+                    # step returns only the loss); its post-update
+                    # eval-mode loss must NOT shadow the train loss
                     ev = self.eval_batch(ins, labs)
-                    logs.update(self._update_metrics(ev, labs))
+                    mlogs = self._update_metrics(ev, labs)
+                    mlogs.pop("loss", None)
+                    logs.update(mlogs)
                 cbks.on_train_batch_end(step_i, logs)
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
@@ -234,9 +242,12 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
         loader = self._loader(eval_data, batch_size, False, num_workers)
-        cbks = callbacks if callbacks is not None else config_callbacks(
-            None, model=self, verbose=verbose, log_freq=log_freq,
-            metrics=[n for m in self._metrics for n in _as_list(m.name())])
+        from .callbacks import CallbackList
+        cbks = callbacks if isinstance(callbacks, CallbackList) else \
+            config_callbacks(callbacks, model=self, verbose=verbose,
+                             log_freq=log_freq,
+                             metrics=[n for m in self._metrics
+                                      for n in _as_list(m.name())])
         cbks.on_eval_begin()
         for m in self._metrics:
             m.reset()
@@ -289,10 +300,22 @@ class Model:
     def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
         from ..framework.io import load
         state = load(path + ".pdparams")
+        skipped = False
+        if skip_mismatch:
+            cur = self.network.state_dict()
+            kept = {k: v for k, v in state.items()
+                    if k in cur and tuple(np.shape(v)) ==
+                    tuple(cur[k].shape)}
+            skipped = len(kept) != len(state)
+            state = kept
         self.network.set_state_dict(state)
         import os
         opt_state = None
-        if not reset_optimizer and os.path.exists(path + ".pdopt"):
+        # a checkpoint whose params were partially skipped has optimizer
+        # slots shaped for the OLD params — restoring them would crash
+        # deep inside the first jitted update
+        if not reset_optimizer and not skipped and \
+                os.path.exists(path + ".pdopt"):
             opt_state = load(path + ".pdopt")
         if self._train_step is not None:
             self._train_step.sync_from_model()

@@ -1,0 +1,5 @@
+from .predictor import Config, PredictorTensor, Predictor, create_predictor
+from .paged_cache import PagedKVCache
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "PagedKVCache"]

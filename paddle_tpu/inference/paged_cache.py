@@ -1,0 +1,166 @@
+"""Paged KV cache manager for the serving path.
+
+Reference parity: the inference engine's KV memory management (the
+reference grows per-request dense caches inside AnalysisPredictor's
+memory optim; modern serving uses paged pools — the PAPERS.md ragged
+paged attention blueprint).  Host-side page accounting (free list, per-
+sequence page lists) stays in python; the page pools are device memory
+consumed by ops.pallas.paged_attention.
+
+One object manages ALL decoder layers (``num_layers`` pools sharing one
+page table): a token occupies the same (page, slot) in every layer, the
+length advances once per token — per-layer bookkeeping cannot drift.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.errors import enforce
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    def __init__(self, n_pages: int, page_size: int, n_kv_heads: int,
+                 head_dim: int, max_seqs: int, max_len: int,
+                 dtype=np.float32, num_layers: int = 1):
+        import jax.numpy as jnp
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.num_layers = num_layers
+        self.max_pages_per_seq = (max_len + page_size - 1) // page_size
+        # [L, KVH, n_pages, P, D]
+        self.k_pages = jnp.zeros((num_layers, n_kv_heads, n_pages,
+                                  page_size, head_dim), dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self._free = list(range(n_pages - 1, 0, -1))   # page 0 = pad
+        self._pages: Dict[int, List[int]] = {}
+        self._lens = np.zeros(max_seqs, np.int32)
+        self._table = np.zeros((max_seqs, self.max_pages_per_seq),
+                               np.int32)
+        self._used = [False] * max_seqs
+
+    # -- host-side accounting --------------------------------------------------
+    def allocate(self, n_tokens: int) -> int:
+        """Reserve a sequence slot with capacity for n_tokens; returns
+        the slot id (batch row for the kernel)."""
+        free_slots = [i for i, u in enumerate(self._used) if not u]
+        enforce(free_slots, "paged cache: all sequence slots in use")
+        slot = free_slots[0]
+        need = (n_tokens + self.page_size - 1) // self.page_size
+        enforce(len(self._free) >= need,
+                f"paged cache OOM: need {need} pages, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._used[slot] = True
+        self._pages[slot] = pages
+        self._lens[slot] = 0
+        self._table[slot, :] = 0
+        self._table[slot, :need] = pages
+        return slot
+
+    def extend(self, slot: int, n_tokens: int = 1):
+        """Ensure capacity for n_tokens more; grabs pages as needed."""
+        have = len(self._pages[slot]) * self.page_size
+        need_total = int(self._lens[slot]) + n_tokens
+        while have < need_total:
+            enforce(self._free, "paged cache OOM on extend")
+            pg = self._free.pop()
+            idx = len(self._pages[slot])
+            self._pages[slot].append(pg)
+            self._table[slot, idx] = pg
+            have += self.page_size
+
+    def release(self, slot: int):
+        self._free.extend(reversed(self._pages.pop(slot)))
+        self._used[slot] = False
+        self._lens[slot] = 0
+        self._table[slot, :] = 0
+
+    def advance(self, slots, n: int = 1):
+        for s in np.atleast_1d(slots):
+            self._lens[s] += n
+
+    @property
+    def seq_lens(self) -> np.ndarray:
+        return self._lens
+
+    @property
+    def page_table(self) -> np.ndarray:
+        return self._table
+
+    def free_page_count(self) -> int:
+        return len(self._free)
+
+    # -- device-side ops -------------------------------------------------------
+    def _norm_layers(self, k, v, tokens_axis: int):
+        """Accept [S?, KVH, D]-style per-layer input when num_layers==1,
+        else require a leading layer dim."""
+        import jax.numpy as jnp
+        k, v = jnp.asarray(k), jnp.asarray(v)
+        if k.ndim == 3:
+            enforce(self.num_layers == 1,
+                    f"cache holds {self.num_layers} layers; pass "
+                    f"[L, ...] keys/values")
+            k, v = k[None], v[None]
+        return k, v
+
+    def write_prefill(self, slot: int, k, v):
+        """Bulk-write a prefill's keys/values into the sequence's pages
+        with ONE vectorized scatter per pool.
+
+        k/v: [S, KVH, D] (num_layers==1) or [L, S, KVH, D]."""
+        import jax.numpy as jnp
+        k, v = self._norm_layers(k, v, 1)
+        s = k.shape[1]
+        self.extend(slot, s)
+        start = int(self._lens[slot])
+        pos = np.arange(start, start + s)
+        pages = jnp.asarray(self._table[slot, pos // self.page_size])
+        slots_ = jnp.asarray(pos % self.page_size)
+        # [L, S, KVH, D] -> [L, KVH, S, D] scatter at (pages, slots)
+        kt = jnp.swapaxes(k, 1, 2).astype(self.k_pages.dtype)
+        vt = jnp.swapaxes(v, 1, 2).astype(self.v_pages.dtype)
+        self.k_pages = self.k_pages.at[:, :, pages, slots_, :].set(kt)
+        self.v_pages = self.v_pages.at[:, :, pages, slots_, :].set(vt)
+        self._lens[slot] = start + s
+
+    def append(self, slots, k_new, v_new):
+        """Decode step: one new token for each sequence in ``slots``.
+
+        k_new/v_new: [B, KVH, D] (num_layers==1) or [L, B, KVH, D];
+        lengths advance by 1 (once, across all layers)."""
+        import jax.numpy as jnp
+        k_new, v_new = self._norm_layers(k_new, v_new, 1)
+        slots = np.atleast_1d(slots)
+        for s in slots:
+            self.extend(int(s), 1)
+        pos = self._lens[slots]
+        pages = jnp.asarray(self._table[slots, pos // self.page_size])
+        slot_in_page = jnp.asarray(pos % self.page_size)
+        # [L, B, KVH, D] -> [L, KVH, B, D]
+        kt = jnp.swapaxes(k_new, 1, 2).astype(self.k_pages.dtype)
+        vt = jnp.swapaxes(v_new, 1, 2).astype(self.v_pages.dtype)
+        self.k_pages = self.k_pages.at[:, :, pages, slot_in_page, :].set(kt)
+        self.v_pages = self.v_pages.at[:, :, pages, slot_in_page, :].set(vt)
+        self.advance(slots, 1)
+
+    def attend(self, slots, q, layer: int = 0,
+               use_kernel: Optional[bool] = None):
+        """Decode attention for ``q`` [B, H, D] over the cached pages of
+        ``slots`` in ``layer``.  Kernel on TPU, jnp reference elsewhere."""
+        import jax.numpy as jnp
+        from ..runtime.device import is_compiled_with_tpu
+        from ..ops.pallas.paged_attention import (paged_attention_raw,
+                                                  paged_attention_reference)
+        slots = np.atleast_1d(slots)
+        table = jnp.asarray(self._table[slots])
+        lens = jnp.asarray(self._lens[slots])
+        if use_kernel is None:
+            use_kernel = is_compiled_with_tpu()
+        fn = paged_attention_raw if use_kernel else \
+            paged_attention_reference
+        return fn(jnp.asarray(q), self.k_pages[layer],
+                  self.v_pages[layer], table, lens)

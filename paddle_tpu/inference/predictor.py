@@ -1,0 +1,180 @@
+"""paddle_inference-shaped predictor (SURVEY.md §1 L8, §3.6).
+
+Reference parity: AnalysisPredictor — load a saved inference program +
+params, feed/fetch by tensor name, Run().  TPU-native design: the
+"analysis passes + NaiveExecutor" pipeline collapses into XLA — the
+artifact is jit.save's StableHLO (.pdmodel/.pdiparams) and Run() is one
+jitted call; zero-copy IO becomes device arrays that stay put between
+runs.  TensorRT/ONNX subgraph knobs are accepted and ignored (documented
+no-ops: XLA is the one compiler here).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.errors import enforce
+
+__all__ = ["Config", "PredictorTensor", "Predictor", "create_predictor"]
+
+
+class Config:
+    """paddle.inference.Config parity (the subset that makes sense on
+    TPU; GPU/TRT/MKLDNN toggles are accepted no-ops)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+
+    def _set_prefix(self, path: str):
+        if path and path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        self._prefix = path
+
+    def set_prog_file(self, path: str):
+        self._set_prefix(path)
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    def set_model(self, path: str, params: Optional[str] = None):
+        """Directory layout (`path/inference.pdmodel`) or prefix."""
+        if os.path.isdir(path):
+            for f in os.listdir(path):
+                if f.endswith(".pdmodel"):
+                    self._prefix = os.path.join(path, f[:-len(".pdmodel")])
+                    return
+            raise FileNotFoundError(f"no .pdmodel under {path}")
+        self._set_prefix(path)
+
+    # device selection
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._device, self._device_id = "tpu", device_id  # alias: GPU→TPU
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    # accepted no-ops (XLA already fuses/optimizes; documented)
+    def switch_ir_optim(self, x=True): ...
+    def enable_memory_optim(self, x=True): ...
+    def enable_tensorrt_engine(self, *a, **k): ...
+    def set_cpu_math_library_num_threads(self, n): ...
+    def switch_use_feed_fetch_ops(self, x): ...
+    def switch_specify_input_names(self, x): ...
+
+
+class PredictorTensor:
+    """Input/output handle (paddle_inference Tensor parity): copy_from_cpu
+    / copy_to_cpu / reshape.  The device array persists between runs."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._host: Optional[np.ndarray] = None
+        self._dev = None
+
+    def reshape(self, shape: Sequence[int]):
+        if self._host is not None:
+            self._host = self._host.reshape(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        import jax
+        self._host = np.ascontiguousarray(arr)
+        self._dev = jax.device_put(self._host)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        import jax
+        if self._dev is not None:
+            return np.asarray(jax.device_get(self._dev))
+        return self._host
+
+    def shape(self):
+        src = self._dev if self._dev is not None else self._host
+        return tuple(src.shape) if src is not None else None
+
+
+class Predictor:
+    """Runs a jit.save'd artifact (or a live Layer) as one jitted call."""
+
+    def __init__(self, config: Optional[Config] = None, layer=None,
+                 input_names: Optional[List[str]] = None):
+        self._inputs: Dict[str, PredictorTensor] = {}
+        self._outputs: Dict[str, PredictorTensor] = {}
+        if layer is not None:
+            self._layer = layer
+            n_in = len(input_names) if input_names else 1
+        else:
+            enforce(config is not None, "Predictor needs Config or layer")
+            from ..jit.save_load import load as jit_load
+            self._layer = jit_load(config._prefix)
+            n_in = len(self._layer._input_specs)
+        self._input_names = (list(input_names) if input_names
+                             else [f"x{i}" for i in range(n_in)])
+        for n in self._input_names:
+            self._inputs[n] = PredictorTensor(n)
+        self._output_names: List[str] = []
+
+    # -- paddle_inference API -------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute.  Either positional `inputs` (returns list of host
+        arrays, the modern paddle_inference convenience) or via the
+        feed/fetch handles."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = [self._inputs[n]._dev for n in self._input_names]
+        enforce(all(a is not None for a in args),
+                "copy_from_cpu every input handle before run()")
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        from ..tensor import Tensor
+        vals = [o.value if isinstance(o, Tensor) else o for o in outs]
+        if not self._output_names:
+            self._output_names = [f"out{i}" for i in range(len(vals))]
+            for n in self._output_names:
+                self._outputs[n] = PredictorTensor(n)
+        for n, v in zip(self._output_names, vals):
+            self._outputs[n]._dev = v
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu()
+                    for n in self._output_names]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+    def clone(self):
+        p = Predictor.__new__(Predictor)
+        p._layer = self._layer
+        p._input_names = list(self._input_names)
+        p._inputs = {n: PredictorTensor(n) for n in self._input_names}
+        p._outputs = {}
+        p._output_names = []
+        return p
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -13,3 +13,4 @@ from .dataloader import (
     TensorDataset,
     random_split,
 )
+from .token_dataset import TokenFileDataset, TokenFileLoader

@@ -24,7 +24,7 @@ from ..ops import random as _random
 from ..optimizer.optimizer import Optimizer
 from ..tensor import Tensor
 
-__all__ = ["CompiledTrainStep"]
+__all__ = ["CompiledTrainStep", "traced_forward"]
 
 
 def _maybe_enable_debug_nans():
@@ -40,6 +40,23 @@ def _maybe_enable_debug_nans():
 def _to_arrays(tree):
     return jax.tree_util.tree_map(
         lambda x: x.value if isinstance(x, Tensor) else jnp.asarray(x), tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+
+def traced_forward(model: Layer, fn: Callable, params, batch, key):
+    """THE tracing contract for running a Layer functionally inside jit:
+    wrap batch leaves as stop-gradient Tensors, swap in the params
+    pytree, pin the RNG stream, run with the tape off, unwrap Tensor
+    outputs.  Single definition — the fused step, eval steps, grad
+    accumulation, and hapi all trace through here."""
+    batch_t = jax.tree_util.tree_map(
+        lambda a: Tensor(a, stop_gradient=True), batch)
+    with tape.no_grad(), functional_state(model, params), \
+            _random.rng_guard(key):
+        out = fn(model, batch_t)
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, Tensor) else x, out,
         is_leaf=lambda x: isinstance(x, Tensor))
 
 
@@ -74,13 +91,7 @@ class CompiledTrainStep:
 
         def step(state, batch, key, lr):
             def pure_loss(p):
-                batch_t = jax.tree_util.tree_map(
-                    lambda a: Tensor(a, stop_gradient=True), batch)
-                with tape.no_grad():
-                    with functional_state(model, p):
-                        with _random.rng_guard(key):
-                            out = loss_fn(model, batch_t)
-                return out.value if isinstance(out, Tensor) else out
+                return traced_forward(model, loss_fn, p, batch, key)
 
             loss, grads = jax.value_and_grad(pure_loss)(state["params"])
             new_params, new_opt = optimizer.apply_gradients(
@@ -115,18 +126,47 @@ class CompiledTrainStep:
             model = self.model
 
             def run(params, batch, key):
-                batch_t = jax.tree_util.tree_map(
-                    lambda a: Tensor(a, stop_gradient=True), batch)
-                with tape.no_grad(), functional_state(model, params), \
-                        _random.rng_guard(key):
-                    out = eval_fn(model, batch_t)
-                return jax.tree_util.tree_map(
-                    lambda x: x.value if isinstance(x, Tensor) else x, out,
-                    is_leaf=lambda x: isinstance(x, Tensor))
+                return traced_forward(model, eval_fn, params, batch, key)
             fn = jax.jit(run)
             self._eval_fns[id(eval_fn)] = fn
         self._key, sub = jax.random.split(self._key)
         return fn(self.state["params"], _to_arrays(batch), sub)
+
+    # -- gradient accumulation ----------------------------------------------
+    def grad_step(self, batch):
+        """fwd+bwd ONLY (no optimizer update): returns (loss, grads) for
+        gradient accumulation (paddle train_batch(update=False))."""
+        if not hasattr(self, "_grad_fn"):
+            model, loss_fn = self.model, self.loss_fn
+
+            def gstep(params, batch, key):
+                def pure_loss(p):
+                    return traced_forward(model, loss_fn, p, batch, key)
+                return jax.value_and_grad(pure_loss)(params)
+
+            self._grad_fn = jax.jit(gstep)
+        self._key, sub = jax.random.split(self._key)
+        return self._grad_fn(self.state["params"], _to_arrays(batch), sub)
+
+    def apply_grads(self, grads):
+        """Optimizer update from externally-computed (accumulated) grads."""
+        if not hasattr(self, "_apply_fn"):
+            optimizer = self.optimizer
+
+            def apply(state, grads, lr):
+                new_params, new_opt = optimizer.apply_gradients(
+                    state["params"], grads, state["opt"], lr=lr)
+                return {"params": new_params, "opt": new_opt}
+
+            # donate the old state like the fused path — without it the
+            # accumulation path holds params+opt twice at the update
+            self._apply_fn = jax.jit(
+                apply, donate_argnums=(0,) if self._donate else ())
+        self.state = self._apply_fn(self.state, grads,
+                                    self.optimizer.get_lr())
+        sched = self.optimizer._lr_scheduler
+        if sched is not None:
+            sched.step()
 
     # -- checkpoint/resume ---------------------------------------------------
     def _ckpt_tree(self):

@@ -1,0 +1,171 @@
+"""Ragged paged attention for TPU decode serving (Pallas/Mosaic).
+
+Reference parity: the reference's inference engine attention path
+(paddle/fluid/inference + phi fused attention kernels, SURVEY.md §1 L8);
+kernel blueprint: "Ragged Paged Attention: A High-Performance and
+Flexible LLM Inference Kernel for TPU" (PAPERS.md).
+
+TPU-native design: the KV cache lives in fixed-size PAGES
+([KVH, n_pages, page_size, D]) so ragged per-sequence lengths share one
+physical pool with no padding waste; a per-sequence page table maps
+logical page slots to physical pages.  The decode kernel runs one grid
+step per (sequence, kv-head, page): the page table is a SCALAR-PREFETCH
+operand, so each page's HBM→VMEM DMA address is computed from it before
+the body runs (Pallas double-buffers the streams); online softmax
+accumulates across a sequence's pages in VMEM scratch, pages past the
+sequence's length are skipped (`@pl.when`), and the query-head group of
+each KV head (GQA) rides the same page DMA.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention_raw", "paged_attention_reference",
+           "paged_write"]
+
+_NEG_INF = float(-1e30)
+_LANES = 128
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size, maxp):
+    b, i = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    npages = (length + page_size - 1) // page_size
+
+    @pl.when(i < npages)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale        # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)                # [P, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0][:, None]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - m_new)                             # [G, P]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0][:, None] * alpha + jnp.sum(p, axis=1)[:, None]
+        v = v_ref[0, 0].astype(jnp.float32)                # [P, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i == maxp - 1)
+    def _():
+        l = jnp.maximum(l_scr[:, 0][:, None], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
+                        scale=None):
+    """Single-token (decode) ragged paged attention.
+
+    q:          [B, H, D] — one query token per sequence.
+    k_pages:    [KVH, n_pages, page_size, D] physical page pool.
+    v_pages:    like k_pages.
+    page_table: [B, max_pages] int32 — physical page per logical slot
+                (entries past a sequence's page count must still be
+                valid indices; their keys are masked by seq_lens).
+    seq_lens:   [B] int32 — valid tokens per sequence.
+
+    Returns [B, H, D].
+    """
+    b, h, d = q.shape
+    kvh, n_pages, page_size, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qg = q.reshape(b, kvh, g, d)
+
+    grid = (b, kvh, maxp)
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               page_size=page_size, maxp=maxp)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b_, h_, i, pt, ln: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda b_, h_, i, pt, ln: (h_, pt[b_, i],
+                                                        0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda b_, h_, i, pt, ln: (h_, pt[b_, i],
+                                                        0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, d),
+                                   lambda b_, h_, i, pt, ln: (b_, h_,
+                                                              0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((g, _LANES), jnp.float32),
+                pltpu.VMEM((g, _LANES), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens):
+    """jnp oracle (and CPU fallback): gather pages into dense [B, S, ...]
+    then masked attention."""
+    b, h, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    g = h // kvh
+    # [B, KVH, maxp, P, D] -> [B, KVH, S, D]
+    kg = jnp.swapaxes(k_pages[:, page_table], 0, 1)
+    vg = jnp.swapaxes(v_pages[:, page_table], 0, 1)
+    s_tot = maxp * page_size
+    kg = kg.reshape(b, kvh, s_tot, d)
+    vg = vg.reshape(b, kvh, s_tot, d)
+    qg = q.reshape(b, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg,
+                   kg.astype(jnp.float32)) / (d ** 0.5)
+    mask = jnp.arange(s_tot)[None, :] < seq_lens[:, None]   # [B, S]
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bksd->bkgd", p, vg.astype(jnp.float32))
+    return o.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_write(k_pages, v_pages, k_new, v_new, page_table, seq_lens):
+    """Append one token per sequence into the page pool.
+
+    k_new/v_new: [B, KVH, D]; the token lands at logical position
+    seq_lens[b] (page page_table[b, pos // P], slot pos % P).
+    Returns (k_pages, v_pages) updated; caller bumps seq_lens.
+    """
+    page_size = k_pages.shape[2]
+    bidx = jnp.arange(k_new.shape[0])
+    pos = seq_lens
+    page = page_table[bidx, pos // page_size]               # [B]
+    slot = pos % page_size
+    k_pages = k_pages.at[:, page, slot, :].set(
+        jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, page, slot, :].set(
+        jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype))
+    return k_pages, v_pages

@@ -1,0 +1,186 @@
+"""Inference: ragged paged attention kernel + PagedKVCache + Predictor
+(SURVEY.md §1 L8; PAPERS.md ragged-paged-attention blueprint)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import (Config, PagedKVCache, Predictor,
+                                  create_predictor)
+from paddle_tpu.ops.pallas.paged_attention import (
+    paged_attention_raw, paged_attention_reference, paged_write)
+
+
+def _rand_pages(rng, kvh=2, n_pages=16, page=8, d=16):
+    k = rng.normal(size=(kvh, n_pages, page, d)).astype(np.float32)
+    v = rng.normal(size=(kvh, n_pages, page, d)).astype(np.float32)
+    return jnp.asarray(k), jnp.asarray(v)
+
+
+def _dense_oracle(q, k_pages, v_pages, page_table, seq_lens):
+    """Straight dense attention on the gathered pages (independent of
+    the module's own reference impl)."""
+    b, h, d = q.shape
+    kvh = k_pages.shape[0]
+    g = h // kvh
+    outs = []
+    for i in range(b):
+        L = int(seq_lens[i])
+        ks, vs = [], []
+        for t in range(L):
+            pg = int(page_table[i, t // k_pages.shape[2]])
+            sl = t % k_pages.shape[2]
+            ks.append(np.asarray(k_pages[:, pg, sl]))
+            vs.append(np.asarray(v_pages[:, pg, sl]))
+        k = np.stack(ks, 1)          # [KVH, L, D]
+        v = np.stack(vs, 1)
+        qh = np.asarray(q[i]).reshape(kvh, g, d)
+        s = np.einsum("kgd,kld->kgl", qh, k) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        outs.append(np.einsum("kgl,kld->kgd", p, v).reshape(h, d))
+    return np.stack(outs)
+
+
+class TestPagedAttentionKernel:
+    def _case(self, seq_lens, page=8, kvh=2, g=2, d=16, maxp=4):
+        rng = np.random.default_rng(0)
+        b = len(seq_lens)
+        h = kvh * g
+        k_pages, v_pages = _rand_pages(rng, kvh, 16, page, d)
+        q = jnp.asarray(rng.normal(size=(b, h, d)).astype(np.float32))
+        # distinct pages per sequence
+        table = np.zeros((b, maxp), np.int32)
+        nxt = 1
+        for i, L in enumerate(seq_lens):
+            for j in range((L + page - 1) // page):
+                table[i, j] = nxt
+                nxt += 1
+        lens = jnp.asarray(np.array(seq_lens, np.int32))
+        table = jnp.asarray(table)
+        return q, k_pages, v_pages, table, lens
+
+    def test_reference_matches_dense(self):
+        args = self._case([5, 16, 23, 1])
+        got = paged_attention_reference(*args)
+        want = _dense_oracle(*args)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_kernel_matches_reference_ragged(self):
+        args = self._case([5, 16, 23, 1])
+        with pltpu.force_tpu_interpret_mode():
+            got = paged_attention_raw(*args)
+        want = paged_attention_reference(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_kernel_full_pages_and_single_token(self):
+        args = self._case([32, 8], maxp=4)
+        with pltpu.force_tpu_interpret_mode():
+            got = paged_attention_raw(*args)
+        want = paged_attention_reference(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_paged_write_places_token(self):
+        rng = np.random.default_rng(1)
+        k_pages, v_pages = _rand_pages(rng)
+        table = jnp.asarray(np.array([[3, 5, 0, 0]], np.int32))
+        lens = jnp.asarray(np.array([9], np.int32))   # next pos 9: page 5 slot 1
+        k_new = jnp.asarray(rng.normal(size=(1, 2, 16)).astype(np.float32))
+        v_new = jnp.asarray(rng.normal(size=(1, 2, 16)).astype(np.float32))
+        k2, v2 = paged_write(k_pages, v_pages, k_new, v_new, table, lens)
+        np.testing.assert_array_equal(np.asarray(k2[:, 5, 1]),
+                                      np.asarray(k_new[0]))
+        np.testing.assert_array_equal(np.asarray(v2[:, 5, 1]),
+                                      np.asarray(v_new[0]))
+        # untouched elsewhere
+        np.testing.assert_array_equal(np.asarray(k2[:, 3]),
+                                      np.asarray(k_pages[:, 3]))
+
+
+class TestPagedKVCache:
+    def test_alloc_extend_release(self):
+        c = PagedKVCache(n_pages=8, page_size=4, n_kv_heads=2, head_dim=8,
+                         max_seqs=4, max_len=16)
+        s0 = c.allocate(6)      # 2 pages
+        s1 = c.allocate(3)      # 1 page
+        assert c.free_page_count() == 7 - 3   # page 0 reserved
+        c.advance(s0, 6)
+        c.extend(s0, 3)         # needs a 3rd page
+        assert c.free_page_count() == 3
+        c.release(s0)
+        assert c.free_page_count() == 6
+        s2 = c.allocate(12)     # reuses freed pages
+        assert c.free_page_count() == 3
+        c.release(s1), c.release(s2)
+        assert c.free_page_count() == 7
+
+    def test_prefill_append_attend_matches_dense_cache(self):
+        rng = np.random.default_rng(2)
+        kvh, d, g = 2, 16, 2
+        c = PagedKVCache(n_pages=32, page_size=8, n_kv_heads=kvh,
+                         head_dim=d, max_seqs=4, max_len=64)
+        pre = rng.normal(size=(11, kvh, d)).astype(np.float32)
+        prev = rng.normal(size=(11, kvh, d)).astype(np.float32)
+        slot = c.allocate(11)
+        c.write_prefill(slot, pre, prev)
+        # append two decode tokens
+        for t in range(2):
+            kn = rng.normal(size=(1, kvh, d)).astype(np.float32)
+            vn = rng.normal(size=(1, kvh, d)).astype(np.float32)
+            c.append(np.array([slot]), kn, vn)
+            pre = np.concatenate([pre, kn], 0)
+            prev = np.concatenate([prev, vn], 0)
+        assert int(c.seq_lens[slot]) == 13
+        q = rng.normal(size=(1, kvh * g, d)).astype(np.float32)
+        got = np.asarray(c.attend(np.array([slot]), q, use_kernel=False))
+        # dense oracle over the accumulated K/V
+        k = np.swapaxes(pre, 0, 1)       # [KVH, L, D]
+        v = np.swapaxes(prev, 0, 1)
+        qh = q.reshape(kvh, g, d)
+        s = np.einsum("kgd,kld->kgl", qh, k) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("kgl,kld->kgd", p, v).reshape(1, kvh * g, d)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+class TestPredictor:
+    def test_save_then_serve(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        net.eval()
+        from paddle_tpu.jit import save as jit_save
+        from paddle_tpu.jit.to_static import InputSpec
+        prefix = str(tmp_path / "inference")
+        jit_save(net, prefix,
+                 input_spec=[InputSpec([4, 8], "float32", "x")])
+
+        cfg = Config(prefix)
+        pred = create_predictor(cfg)
+        x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+
+        # handle-style IO
+        names = pred.get_input_names()
+        pred.get_input_handle(names[0]).copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+
+        want = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5,
+                                   atol=1e-5)
+
+        # convenience run(inputs)
+        out2 = pred.run([x])[0]
+        np.testing.assert_allclose(out2, out, rtol=1e-6)
+
+        # clone shares the compiled program but not the handles
+        p2 = pred.clone()
+        out3 = p2.run([x])[0]
+        np.testing.assert_allclose(out3, out, rtol=1e-6)

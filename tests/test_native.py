@@ -1,0 +1,139 @@
+"""Native runtime components: TCPStore (tcp_store.cpp) and the dataio
+reader (dataio.cpp) with their python fallbacks (SURVEY.md §2.4 store
+row, §2.2 io row)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import load_native
+from paddle_tpu.distributed.store import TCPStore, _PyClient
+from paddle_tpu.io import TokenFileDataset, TokenFileLoader
+
+
+def test_native_library_builds():
+    """g++ is in this image: the native lib must actually build."""
+    assert load_native() is not None
+
+
+class TestTCPStore:
+    def test_set_get_add_check_delete(self):
+        master = TCPStore("127.0.0.1", 0, world_size=2, is_master=True)
+        client = TCPStore("127.0.0.1", master.port, world_size=2)
+
+        master.set("alpha", b"hello")
+        assert client.get("alpha") == b"hello"
+        assert client.check("alpha")
+        assert not client.check("nope")
+
+        assert client.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+
+        client.set("beta", "text value")
+        assert master.get("beta") == b"text value"
+
+        master.delete_key("alpha")
+        assert not client.check("alpha")
+
+    def test_blocking_get_and_wait(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        client = TCPStore("127.0.0.1", master.port)
+
+        def late_set():
+            import time
+            time.sleep(0.2)
+            master.set("late", b"v")
+
+        t = threading.Thread(target=late_set)
+        t.start()
+        assert client.get("late", timeout_ms=5000) == b"v"
+        t.join()
+        with pytest.raises(TimeoutError):
+            client.wait("never", timeout_ms=100)
+
+    def test_barrier_two_ranks(self):
+        master = TCPStore("127.0.0.1", 0, world_size=2, is_master=True)
+        client = TCPStore("127.0.0.1", master.port, world_size=2)
+        done = []
+
+        def rank1():
+            client.barrier("b0")
+            done.append(1)
+
+        t = threading.Thread(target=rank1)
+        t.start()
+        master.barrier("b0")
+        t.join(timeout=10)
+        assert done == [1]
+
+    def test_python_client_speaks_native_protocol(self):
+        """The pure-python client must interoperate with the native
+        server (mixed gangs: some hosts without a toolchain)."""
+        if load_native() is None:
+            pytest.skip("no native lib")
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        assert master._native_server is not None
+        py = _PyClient("127.0.0.1", master.port, timeout_s=10)
+        py._req(0, b"k", 3, b"xyz")            # SET
+        assert py._req(1, b"k", 0) == b"xyz"   # GET
+        import struct
+        assert struct.unpack(
+            "<q", py._req(2, b"n", 4))[0] == 4  # ADD
+        py.close()
+
+
+class TestDataIO:
+    def _token_file(self, tmp_path, n_tokens=4096, dtype=np.int32):
+        arr = np.arange(n_tokens, dtype=dtype)
+        p = tmp_path / "tokens.bin"
+        arr.tofile(p)
+        return str(p), arr
+
+    def test_dataset_getitem(self, tmp_path):
+        p, arr = self._token_file(tmp_path)
+        ds = TokenFileDataset(p, seq_len=128)
+        assert len(ds) == 32
+        np.testing.assert_array_equal(ds[3], arr[3 * 128:4 * 128])
+
+    def test_native_loader_sequential(self, tmp_path):
+        p, arr = self._token_file(tmp_path)
+        ld = TokenFileLoader(p, seq_len=64, batch_size=4)
+        assert ld.is_native
+        assert len(ld) == 16
+        b0 = ld.next()
+        assert b0.shape == (4, 64)
+        np.testing.assert_array_equal(b0.ravel(), arr[:4 * 64])
+        b1 = ld.next()
+        np.testing.assert_array_equal(b1.ravel(), arr[4 * 64:8 * 64])
+        ld.close()
+
+    def test_native_loader_wraps_epochs(self, tmp_path):
+        p, arr = self._token_file(tmp_path, n_tokens=512)
+        ld = TokenFileLoader(p, seq_len=64, batch_size=4)   # 2 batches
+        first = ld.next().copy()
+        ld.next()
+        again = ld.next()      # epoch 2, batch 0
+        np.testing.assert_array_equal(first, again)
+        ld.close()
+
+    def test_native_matches_python_fallback(self, tmp_path):
+        p, arr = self._token_file(tmp_path)
+        nat = TokenFileLoader(p, seq_len=64, batch_size=4)
+        # force the fallback path
+        py = TokenFileLoader.__new__(TokenFileLoader)
+        py.seq_len, py.batch_size, py.dtype = 64, 4, np.dtype(np.int32)
+        py._lib, py._h = None, None
+        py._mm = np.memmap(p, dtype=np.int32, mode="r")
+        py._n = (len(py._mm) // 64) // 4
+        py._order = np.arange(len(py._mm) // 64)
+        py._i = 0
+        for _ in range(3):
+            np.testing.assert_array_equal(nat.next(), py.next())
+        nat.close()
+
+    def test_shuffled_loader_covers_all_sequences(self, tmp_path):
+        p, arr = self._token_file(tmp_path, n_tokens=1024)
+        ld = TokenFileLoader(p, seq_len=64, batch_size=4, shuffle_seed=7)
+        seen = np.concatenate([ld.next().ravel() for _ in range(len(ld))])
+        np.testing.assert_array_equal(np.sort(seen), arr)
+        ld.close()
