@@ -52,7 +52,13 @@ struct Reader {
   std::vector<std::thread> workers;
 
   ~Reader() {
-    stop.store(true);
+    {
+      // store+notify under the mutex: a lock-free notify can land while
+      // a worker holds mu evaluating its wait predicate -> lost wakeup
+      // -> join() blocks forever
+      std::lock_guard<std::mutex> lk(mu);
+      stop.store(true);
+    }
     cv_full.notify_all();
     cv_empty.notify_all();
     for (auto &w : workers)

@@ -16,6 +16,7 @@
 //   request:  u8 op | u32 klen | key bytes | u64 arg_or_vlen | value
 //   response: u64 len | payload   (ADD: payload = i64 new value)
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -36,6 +37,19 @@ namespace {
 
 enum Op : uint8_t { SET = 0, GET = 1, ADD = 2, WAIT = 3, DEL = 4,
                     CHECK = 5 };
+
+// hostname OR dotted-quad -> in_addr (inet_addr alone cannot resolve
+// names like "localhost")
+bool resolve_ipv4(const char *host, in_addr *out) {
+  if (inet_pton(AF_INET, host, out) == 1) return true;
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) return false;
+  *out = reinterpret_cast<sockaddr_in *>(res->ai_addr)->sin_addr;
+  freeaddrinfo(res);
+  return true;
+}
 
 struct Server {
   int listen_fd = -1;
@@ -203,7 +217,12 @@ void *tcp_store_server_start(const char *host, int port, int *out_port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  addr.sin_addr.s_addr = host && *host ? inet_addr(host) : INADDR_ANY;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  if (host && *host && !resolve_ipv4(host, &addr.sin_addr)) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   if (::bind(s->listen_fd, reinterpret_cast<sockaddr *>(&addr),
              sizeof(addr)) < 0 ||
       ::listen(s->listen_fd, 128) < 0) {
@@ -233,7 +252,7 @@ int tcp_store_connect(const char *host, int port, int timeout_ms) {
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
-    addr.sin_addr.s_addr = inet_addr(host);
+    if (!resolve_ipv4(host, &addr.sin_addr)) { ::close(fd); return -1; }
     if (::connect(fd, reinterpret_cast<sockaddr *>(&addr),
                   sizeof(addr)) == 0) {
       int one = 1;

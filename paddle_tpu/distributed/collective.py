@@ -11,14 +11,16 @@ TPU-native design: two layers —
      These are what PP schedules and ring attention use — XLA lowers them
      to ICI collectives.
   2. **Eager module functions** with paddle signatures.  Under a tracer
-     they dispatch to (1).  On concrete global arrays the single-
-     controller model means the tensor is already global: all_reduce is
-     the identity on replicated values, all_gather/reduce_scatter/
-     broadcast become resharding ops.  (The reference's per-process view
-     does not exist under SPMD — documented mapping, SURVEY.md §2.4.)
+     they dispatch to (1).  On concrete values: multi-process runtimes
+     get TRUE per-rank semantics (each process contributes its local
+     value through a tiny process-spanning XLA program — the reference's
+     ProcessGroup contract); in a single-controller process a concrete
+     array is already the global value, so all_reduce/broadcast are
+     identities there (documented mapping, SURVEY.md §2.4).
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -65,30 +67,62 @@ def _set_default_group(g: CommGroup):
     _DEFAULT_GROUP = g
 
 
+class ProcessSubsetGroup:
+    """Eager process-level group over an explicit rank subset (reference
+    ``new_group(ranks=[...])``).  Usable with the EAGER collectives
+    (all_reduce/all_gather/broadcast/barrier on concrete values — they
+    run a tiny process-spanning XLA program); not usable inside
+    compiled SPMD regions, where groups are mesh axes."""
+
+    def __init__(self, ranks: List[int]):
+        import numpy as np
+        self.ranks = sorted(int(r) for r in ranks)
+        # one representative device per member process
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        enforce(all(r in per_proc for r in self.ranks),
+                f"new_group ranks {ranks} outside process world "
+                f"{sorted(per_proc)}")
+        self.devices = [per_proc[r] for r in self.ranks]
+        self.mesh = Mesh(np.array(self.devices), ("pg",))
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def is_member(self) -> bool:
+        return jax.process_index() in self.ranks
+
+    def rank_in_group(self, rank=None) -> int:
+        r = jax.process_index() if rank is None else rank
+        return self.ranks.index(r) if r in self.ranks else -1
+
+
 def new_group(ranks: Optional[List[int]] = None, backend=None,
-              axis: Optional[Union[str, Sequence[str]]] = None) -> CommGroup:
-    """paddle.distributed.new_group.  On the mesh model a group is a mesh
-    axis (pass ``axis=``); explicit rank lists are accepted only for the
-    trivial all-ranks case."""
+              axis: Optional[Union[str, Sequence[str]]] = None):
+    """paddle.distributed.new_group.  Inside compiled SPMD programs a
+    group is a mesh axis (pass ``axis=``); an explicit rank list builds
+    a process-subset group for the eager collectives."""
     from . import fleet
-    hcg = fleet.get_hybrid_communicate_group()
-    enforce(hcg is not None, "fleet.init() first")
     if axis is not None:
+        hcg = fleet.get_hybrid_communicate_group()
+        enforce(hcg is not None, "fleet.init() first")
         g = CommGroup(hcg.mesh, tuple([axis] if isinstance(axis, str)
                                       else axis))
+    elif ranks is not None and \
+            sorted(ranks) != list(range(jax.process_count())):
+        g = ProcessSubsetGroup(ranks)
     else:
-        g = hcg.get_check_parallel_group()
-        if ranks is not None:
-            from .env import get_world_size
-            # "all ranks" in either unit: process count (paddle's
-            # get_world_size idiom) or mesh device count
-            all_ranks = (list(range(get_world_size())),
-                         list(range(g.nranks)))
-            if sorted(ranks) not in all_ranks:
-                raise NotImplementedError(
-                    f"new_group(ranks={ranks}): arbitrary rank subsets do "
-                    "not map onto the SPMD mesh — pass axis='dp'/'mp'/... "
-                    "to get the per-axis group instead")
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is None and ranks is not None:
+            g = ProcessSubsetGroup(ranks)
+        else:
+            enforce(hcg is not None, "fleet.init() first")
+            g = hcg.get_check_parallel_group()
     _GROUPS[id(g)] = g
     return g
 
@@ -135,14 +169,83 @@ def axis_index(group: Union[CommGroup, str]):
 
 
 # ---------------------------------------------------------------------------
+# Cross-process eager transport (multi-host: each process contributes its
+# LOCAL value — the reference's per-rank collective semantics)
+# ---------------------------------------------------------------------------
+
+_WORLD_PG: Optional[ProcessSubsetGroup] = None
+
+
+def _world_proc_group() -> ProcessSubsetGroup:
+    global _WORLD_PG
+    if _WORLD_PG is None or _WORLD_PG.nranks != jax.process_count():
+        _WORLD_PG = ProcessSubsetGroup(list(range(jax.process_count())))
+    return _WORLD_PG
+
+
+_CROSS_JITS = {}
+
+
+def _cross_process(val, fn, group=None, fn_key=None):
+    """Stack each member process's local ``val`` on a leading axis
+    sharded over one-device-per-process, apply ``fn`` replicated (GSPMD
+    emits the DCN/ICI collective), return the host result — or None for
+    non-members.  The jitted program is cached per (fn_key, mesh) so a
+    per-step eager collective does not retrace/recompile every call."""
+    import numpy as np
+    pg = group if isinstance(group, ProcessSubsetGroup) \
+        else _world_proc_group()
+    if not pg.is_member:
+        return None
+    arr_np = np.asarray(val)
+    sh = NamedSharding(pg.mesh, PartitionSpec("pg"))
+    gshape = (pg.nranks,) + tuple(arr_np.shape)
+    mine = [d for d in pg.devices
+            if d.process_index == jax.process_index()]
+    local = [jax.device_put(arr_np[None], d) for d in mine]
+    arr = jax.make_array_from_single_device_arrays(gshape, sh, local)
+    cache_key = (fn_key if fn_key is not None else fn, pg.mesh)
+    jitted = _CROSS_JITS.get(cache_key)
+    if jitted is None:
+        jitted = jax.jit(fn, out_shardings=NamedSharding(
+            pg.mesh, PartitionSpec()))
+        _CROSS_JITS[cache_key] = jitted
+    out = jitted(arr)
+    return np.asarray(jax.device_get(out))
+
+
+def _gather_tiled(a):
+    return a.reshape((-1,) + a.shape[2:])
+
+
+def _gather_stacked(a):
+    return a
+
+
+def _take_row(a, idx):
+    return a[idx]
+
+
+_EAGER_REDUCERS = {
+    ReduceOp.SUM: lambda a: jnp.sum(a, 0),
+    ReduceOp.MAX: lambda a: jnp.max(a, 0),
+    ReduceOp.MIN: lambda a: jnp.min(a, 0),
+    ReduceOp.PROD: lambda a: jnp.prod(a, 0),
+    ReduceOp.AVG: lambda a: jnp.mean(a, 0),
+}
+
+
+# ---------------------------------------------------------------------------
 # Layer 2: paddle-shaped eager API
 # ---------------------------------------------------------------------------
 
-def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
-               sync_op: bool = True):
-    group = group or _default_group()
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True):
+    if not isinstance(group, ProcessSubsetGroup):
+        group = group or _default_group()
     val = _unwrap(tensor)
     if _is_traced(val):
+        enforce(isinstance(group, CommGroup),
+                "traced collectives need a mesh-axis group")
         if op == ReduceOp.PROD:
             # no lax.pprod: gather the axis and reduce locally
             gathered = lax.all_gather(val, group.axis_name)
@@ -153,7 +256,16 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[CommGroup] = None,
             enforce(op in fns, f"unsupported ReduceOp {op!r}")
             out = fns[op](val, group.axis_name)
         return Tensor(out) if isinstance(tensor, Tensor) else out
-    # concrete global array: already globally reduced under SPMD
+    if jax.process_count() > 1:
+        # true per-rank semantics across processes (reference contract)
+        res = _cross_process(
+            val, _EAGER_REDUCERS[op],
+            group if isinstance(group, ProcessSubsetGroup) else None,
+            fn_key=("reduce", op))
+        if res is None:
+            return tensor
+        return Tensor(res) if isinstance(tensor, Tensor) else res
+    # single controller, concrete global array: already globally reduced
     return tensor
 
 
@@ -170,12 +282,29 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
             n = group.nranks
             tensor_or_list.extend(Tensor(out[i]) for i in range(n))
             return
+        if jax.process_count() > 1:
+            res = _cross_process(
+                val, _gather_stacked,
+                group if isinstance(group, ProcessSubsetGroup) else None,
+                fn_key="gather_stacked")
+            if res is not None:
+                tensor_or_list.extend(Tensor(res[i])
+                                      for i in range(res.shape[0]))
+                return
         tensor_or_list.extend(Tensor(val) for _ in range(group.nranks))
         return
     val = _unwrap(tensor_or_list)
     if _is_traced(val):
         out = lax.all_gather(val, group.axis_name, tiled=True)
         return Tensor(out) if isinstance(tensor_or_list, Tensor) else out
+    if jax.process_count() > 1:
+        res = _cross_process(
+            val, _gather_tiled,
+            group if isinstance(group, ProcessSubsetGroup) else None,
+            fn_key="gather_tiled")
+        if res is not None:
+            return Tensor(res) if isinstance(tensor_or_list, Tensor) \
+                else res
     return tensor_or_list
 
 
@@ -214,9 +343,19 @@ def all_to_all(out_tensor_list, in_tensor_list=None,
 alltoall = all_to_all
 
 
-def broadcast(tensor, src: int = 0, group: Optional[CommGroup] = None,
-              sync_op: bool = True):
-    # SPMD: one logical value — broadcast is identity
+def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
+    val = _unwrap(tensor)
+    if not _is_traced(val) and jax.process_count() > 1:
+        pg = group if isinstance(group, ProcessSubsetGroup) \
+            else _world_proc_group()
+        idx = pg.rank_in_group(src)
+        enforce(idx >= 0, f"broadcast src {src} not in group {pg.ranks}")
+        res = _cross_process(val, functools.partial(_take_row, idx=idx),
+                             pg, fn_key=("bcast", idx))
+        if res is None:
+            return tensor
+        return Tensor(res) if isinstance(tensor, Tensor) else res
+    # single controller SPMD: one logical value — broadcast is identity
     return tensor
 
 
@@ -233,7 +372,12 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
     return all_reduce(tensor, op, group, sync_op)
 
 
-def barrier(group: Optional[CommGroup] = None):
+def barrier(group=None):
+    if jax.process_count() > 1:
+        _cross_process(jnp.zeros((1,)), _EAGER_REDUCERS[ReduceOp.SUM],
+                       group if isinstance(group, ProcessSubsetGroup)
+                       else None, fn_key=("reduce", ReduceOp.SUM))
+        return
     jax.block_until_ready(jnp.zeros(()))
 
 

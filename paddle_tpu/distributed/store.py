@@ -150,7 +150,8 @@ class _PyClient:
     def _req(self, op, key: bytes, arg: int, val: bytes = b"") -> bytes:
         with self._lock:
             msg = struct.pack("<BI", op, len(key)) + key + \
-                struct.pack("<Q", arg) + (val if op == _SET else b"")
+                struct.pack("<Q", arg & ((1 << 64) - 1)) + \
+                (val if op == _SET else b"")
             self._sock.sendall(msg)
             raw = _PyServer._read_n(self._sock, 8)
             enforce(raw is not None, "TCPStore connection lost")
@@ -259,7 +260,7 @@ class TCPStore:
         return self._client._req(_GET, key.encode(), timeout_ms)
 
     def add(self, key: str, delta: int) -> int:
-        out = self._client._req(_ADD, key.encode(), delta & ((1 << 64) - 1))
+        out = self._client._req(_ADD, key.encode(), int(delta))
         return struct.unpack("<q", out)[0]
 
     def wait(self, keys, timeout_ms: int = 0) -> None:

@@ -240,8 +240,34 @@ def default_collate_fn(batch: List[Any]):
     return batch
 
 
+def _mp_worker_loop(dataset, task_q, res_q, init_fn, wid):
+    """Subprocess worker: evaluates dataset[i] (numpy-level — workers
+    must not touch jax; collation and device placement stay in the
+    parent) and ships raw items back."""
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        bid, idxs = task
+        try:
+            res_q.put((bid, [dataset[i] for i in idxs], None))
+        except Exception as e:                     # surfaced in parent
+            res_q.put((bid, None, repr(e)))
+            return
+
+
 class DataLoader:
-    """paddle.io.DataLoader-shaped loader with background prefetching."""
+    """paddle.io.DataLoader-shaped loader.
+
+    ``num_workers=0``: synchronous in-process iteration.
+    ``num_workers>0``: that many FORKED worker processes evaluate
+    ``dataset[i]`` in parallel (the reference's multiprocess DataLoader
+    contract); raw items return via queues, the parent collates and
+    places on device.  IterableDataset streams use a thread prefetcher
+    (a python iterator cannot be sharded across forks safely).
+    """
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -249,6 +275,8 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = max(2, prefetch_factor)
@@ -280,11 +308,71 @@ class DataLoader:
                 items = [self.dataset[i] for i in idx_batch]
                 yield self.collate_fn(items)
 
+    def _mp_iter(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        task_q = ctx.Queue()
+        res_q = ctx.Queue()
+        n_workers = min(self.num_workers, max(1, len(batches)))
+        procs = [ctx.Process(target=_mp_worker_loop,
+                             args=(self.dataset, task_q, res_q,
+                                   self.worker_init_fn, w), daemon=True)
+                 for w in range(n_workers)]
+        for p in procs:
+            p.start()
+        try:
+            # backpressure: keep only ~prefetch_factor batches in flight
+            # per worker; refill as the consumer drains (an up-front full
+            # enqueue lets workers materialize the whole epoch in RAM)
+            inflight_cap = max(n_workers * self.prefetch_factor,
+                               n_workers)
+            issued = 0
+            done_markers = 0
+
+            def _issue():
+                nonlocal issued, done_markers
+                if issued < len(batches):
+                    task_q.put((issued, list(batches[issued])))
+                    issued += 1
+                elif done_markers < n_workers:
+                    task_q.put(None)
+                    done_markers += 1
+
+            for _ in range(min(inflight_cap, len(batches)) + n_workers):
+                _issue()
+            pending = {}
+            expect = 0
+            timeout = self.timeout or None
+            while expect < len(batches):
+                if expect in pending:
+                    items = pending.pop(expect)
+                else:
+                    bid, items, err = res_q.get(timeout=timeout)
+                    if err is not None:
+                        raise RuntimeError(f"DataLoader worker failed: "
+                                           f"{err}")
+                    if bid != expect:
+                        pending[bid] = items
+                        continue
+                yield self.collate_fn(items)
+                expect += 1
+                _issue()
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._gen_batches()
             return
-        # thread prefetcher
+        if not self._iterable and self.num_workers > 0:
+            yield from self._mp_iter()
+            return
+        # iterable streams: thread prefetcher
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
 

@@ -23,6 +23,8 @@ from ..ops.api import (  # noqa: F401
     relu, relu6, rms_norm, selu, sigmoid, sigmoid_focal_loss, silu,
     smooth_l1_loss, softmax, softplus, softshrink, softsign, swish,
     tanhshrink, thresholded_relu, unfold,
+    affine_grid, alpha_dropout, channel_shuffle, dropout2d, dropout3d,
+    fold, fused_linear, grid_sample, pixel_unshuffle, upsample,
 )
 from ..ops import api as _api
 from ..tensor import apply_op

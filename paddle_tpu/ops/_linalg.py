@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def norm(x, p=None, axis=None, keepdim=False):
@@ -123,5 +124,52 @@ def corrcoef(x, rowvar=True):
     return jnp.corrcoef(x, rowvar=rowvar)
 
 
-def histogramdd(*a, **k):
-    raise NotImplementedError
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges,
+                                  density=density, weights=weights)
+    return hist, list(edges)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def lu(x, pivot=True, get_infos=False):
+    """paddle.linalg.lu: returns (LU, pivots[, infos]) — LAPACK-style
+    packed LU with 1-based pivots (paddle convention)."""
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1
+    if get_infos:
+        infos = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_, piv, infos
+    return lu_, piv
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Pairwise p-norm distances [..., M, N] between [..., M, D] and
+    [..., N, D] (MXU path for p=2: the |x|^2 - 2xy + |y|^2 expansion)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if p == 2.0 and "use_mm" in str(compute_mode):
+        x2 = jnp.sum(x * x, -1)[..., :, None]
+        y2 = jnp.sum(y * y, -1)[..., None, :]
+        xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))
+        return jnp.sqrt(jnp.maximum(x2 - 2 * xy + y2, 0.0))
+    d = x[..., :, None, :] - y[..., None, :, :]
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d), -1)
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distances of [N, D] (upper triangle, paddle
+    pdist contract)."""
+    n = x.shape[0]
+    full = cdist(x, x, p=p)
+    iu, ju = np.triu_indices(n, k=1)
+    return full[iu, ju]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)

@@ -295,3 +295,85 @@ def one_hot(x, num_classes):
 
 def unbind(x, axis=0):
     return unstack(x, axis=axis)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(tuple(int(s) for s in np.asarray(shape)),
+                      jnp.asarray(updates).dtype)
+    idx = tuple(jnp.moveaxis(jnp.asarray(index), -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+def masked_scatter(x, mask, value):
+    """Fill masked positions of x from value's leading elements (paddle
+    masked_scatter; static-shape friendly via cumsum indexing)."""
+    x = jnp.asarray(x)
+    m = jnp.broadcast_to(jnp.asarray(mask), x.shape).reshape(-1)
+    v = jnp.asarray(value).reshape(-1)
+    pos = jnp.cumsum(m) - 1                      # index into v per slot
+    gathered = v[jnp.clip(pos, 0, v.shape[0] - 1)]
+    return jnp.where(m, gathered, x.reshape(-1)).reshape(x.shape)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """paddle.as_strided on the flattened buffer (gather-based: XLA has
+    no aliasing views; this materializes the strided window)."""
+    flat = jnp.reshape(x, [-1])
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+    idx = jnp.asarray(offset)
+    for size, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(size) * st
+    return flat[idx]
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, [int(s) for s in shape_or_dtype])
+    from ..common.dtype import convert_dtype
+    dt = convert_dtype(shape_or_dtype)
+    out = jax.lax.bitcast_convert_type(x, dt)
+    # paddle contract: the LAST dim absorbs the itemsize ratio (lax
+    # appends/consumes a trailing ratio dim instead)
+    if out.ndim == x.ndim + 1:          # narrowing: fold trailing dim
+        return out.reshape(out.shape[:-2] + (-1,))
+    return out
+
+
+def view_as(x, other):
+    return jnp.reshape(x, other.shape)
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new_shape = list(x.shape[:axis]) + [int(s) for s in shape] \
+        + list(x.shape[axis + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+def take(x, index, mode="raise"):
+    flat = jnp.reshape(x, [-1])
+    idx = jnp.asarray(index)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = idx % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def atleast_1d(*xs):
+    out = [jnp.atleast_1d(x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*xs):
+    out = [jnp.atleast_2d(x) for x in xs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*xs):
+    out = [jnp.atleast_3d(x) for x in xs]
+    return out[0] if len(out) == 1 else out

@@ -386,3 +386,73 @@ def addmm(input, x, y, beta=1.0, alpha=1.0):
 
 def multiply_(x, y):
     return jnp.multiply(x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def logcumsumexp(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.reshape(x, [-1])
+        axis = 0
+    if dtype is not None:
+        from ..common.dtype import convert_dtype
+        x = x.astype(convert_dtype(dtype))
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    """Returns (values, indices) like paddle.cummin."""
+    if axis is None:
+        x = jnp.reshape(x, [-1])
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n)
+    shape = [1] * x.ndim
+    shape[axis] = n
+    idx = jnp.reshape(idx, shape)
+    is_new = x == vals
+    idx_where = jnp.where(is_new, jnp.broadcast_to(idx, x.shape), -1)
+    inds = jax.lax.cummax(idx_where, axis=axis)
+    from ..common.dtype import convert_dtype
+    return vals, inds.astype(convert_dtype(dtype))
+
+
+def cummax(x, axis=None, dtype="int64"):
+    if axis is None:
+        x = jnp.reshape(x, [-1])
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    n = x.shape[axis]
+    shape = [1] * x.ndim
+    shape[axis] = n
+    idx = jnp.reshape(jnp.arange(n), shape)
+    is_new = x == vals
+    idx_where = jnp.where(is_new, jnp.broadcast_to(idx, x.shape), -1)
+    inds = jax.lax.cummax(idx_where, axis=axis)
+    from ..common.dtype import convert_dtype
+    return vals, inds.astype(convert_dtype(dtype))
+
+
+def renorm(x, p, axis, max_norm):
+    """Renormalize slices along ``axis`` to at most ``max_norm`` in p-norm."""
+    axis = axis % x.ndim
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def polygamma(x, n):
+    from jax.scipy.special import polygamma as _pg
+    return _pg(n, x)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)

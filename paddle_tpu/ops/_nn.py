@@ -390,7 +390,20 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     dilation = _norm_tuple(dilation, n)
     output_padding = _norm_tuple(output_padding, n)
     if isinstance(padding, str):
-        raise NotImplementedError("string padding for conv_transpose")
+        # paddle accepts SAME/VALID here: VALID = no padding; SAME makes
+        # out = in*stride (requires effective kernel >= stride)
+        k_ = weight.shape[2:]
+        if padding.upper() == "VALID":
+            padding = 0
+        elif padding.upper() == "SAME":
+            pads = []
+            for i in range(n):
+                eff = (k_[i] - 1) * _norm_tuple(dilation, n)[i] + 1
+                tot = max(eff - _norm_tuple(stride, n)[i], 0)
+                pads.append((tot // 2, tot - tot // 2))
+            padding = pads
+        else:
+            raise ValueError(f"bad conv_transpose padding {padding!r}")
     padv = _norm_tuple(padding, n) if not isinstance(padding, (list, tuple)) \
         or all(isinstance(p, int) for p in padding) else padding
     if isinstance(padv[0], int):
@@ -457,13 +470,30 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return summed / float(np.prod(k))
 
 
+def _adaptive_pool2d(x, output_size, reduce_fn):
+    """General adaptive pooling: bin i covers [floor(i*H/out),
+    ceil((i+1)*H/out)) — small static python loops over output bins
+    (output sizes are tiny; XLA fuses the slices)."""
+    oh, ow = _norm_tuple(output_size, 2)
+    h, w = x.shape[2], x.shape[3]
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(reduce_fn(x[:, :, h0:h1, w0:w1]))
+        rows.append(jnp.stack(cols, -1))
+    return jnp.stack(rows, -2)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     out = _norm_tuple(output_size, 2)
     h, w = x.shape[2], x.shape[3]
     if h % out[0] == 0 and w % out[1] == 0:
         kh, kw = h // out[0], w // out[1]
         return avg_pool2d(x, (kh, kw), (kh, kw), 0)
-    raise NotImplementedError("adaptive pool with non-divisible sizes")
+    return _adaptive_pool2d(x, out, lambda s: jnp.mean(s, axis=(2, 3)))
 
 
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
@@ -472,7 +502,7 @@ def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     if h % out[0] == 0 and w % out[1] == 0:
         kh, kw = h // out[0], w // out[1]
         return max_pool2d(x, (kh, kw), (kh, kw), 0)
-    raise NotImplementedError("adaptive pool with non-divisible sizes")
+    return _adaptive_pool2d(x, out, lambda s: jnp.max(s, axis=(2, 3)))
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
@@ -746,3 +776,150 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
     if prior_dist is None:
         return (1 - epsilon) * label + epsilon / n
     return (1 - epsilon) * label + epsilon * prior_dist
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * r * r, h // r, w // r)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, 1, -1)
+    return x
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    """Whole-channel dropout (paddle F.dropout2d)."""
+    if not training or p == 0.0:
+        return x
+    caxis = 1 if data_format == "NCHW" else 3
+    shape = tuple(x.shape[i] if i in (0, caxis) else 1
+                  for i in range(x.ndim))
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p,
+                                shape).astype(x.dtype)
+    return x * keep / (1.0 - p)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    if not training or p == 0.0:
+        return x
+    caxis = 1 if data_format == "NCDHW" else 4
+    shape = tuple(x.shape[i] if i in (0, caxis) else 1
+                  for i in range(x.ndim))
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p,
+                                shape).astype(x.dtype)
+    return x * keep / (1.0 - p)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (paddle F.alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, x.shape)
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * p * alpha_p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """Inverse of unfold: [N, C*kh*kw, L] -> [N, C, H, W] with
+    overlapping patches summed (col2im)."""
+    n, ckk, L = x.shape
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _norm_tuple(paddings, 2)
+    out_h, out_w = _norm_tuple(output_sizes, 2)
+    c = ckk // (k[0] * k[1])
+    ph, pw = out_h + 2 * p[0], out_w + 2 * p[1]
+    nh = (ph - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    nw = (pw - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], nh, nw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hs = i * d[0]
+            ws = j * d[1]
+            out = out.at[:, :, hs:hs + nh * s[0]:s[0],
+                         ws:ws + nw * s[1]:s[1]].add(cols[:, :, i, j])
+    return out[:, :, p[0]:p[0] + out_h, p[1]:p[1] + out_w]
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """paddle F.affine_grid: theta [N, 2, 3] -> grid [N, H, W, 2]."""
+    n, _, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)      # [H, W, 3]
+    return jnp.einsum("nij,hwj->nhwi", jnp.asarray(theta), base)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """paddle F.grid_sample (NCHW, bilinear/nearest, zeros/border)."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+    def gather(iy, ix):
+        iyc = jnp.clip(iy, 0, h - 1)
+        ixc = jnp.clip(ix, 0, w - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            ok = ((iy >= 0) & (iy <= h - 1) & (ix >= 0) &
+                  (ix <= w - 1))[..., None]
+            vals = jnp.where(ok, vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(fy).astype(jnp.int32),
+                     jnp.round(fx).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None] +
+               gather(y0, x1) * (wx * (1 - wy))[..., None] +
+               gather(y1, x0) * ((1 - wx) * wy)[..., None] +
+               gather(y1, x1) * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1)                          # NCHW
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners,
+                       data_format=data_format)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = weight.T if transpose_weight else weight
+    return linear(x, w, bias)

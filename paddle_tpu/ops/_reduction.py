@@ -94,18 +94,23 @@ def cumprod(x, dim=None, dtype=None):
     return jnp.cumprod(x, axis=dim, dtype=dtype)
 
 
-def cummax(x, axis=None):
-    if axis is None:
-        x = jnp.reshape(x, (-1,))
-        axis = 0
-    import jax.lax as lax
-    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
-    return vals
-
-
 def count_nonzero(x, axis=None, keepdim=False):
     return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
 
 
 def trace(x, offset=0, axis1=0, axis2=1):
     return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)

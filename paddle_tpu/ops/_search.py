@@ -58,7 +58,25 @@ def kthvalue(x, k, axis=-1, keepdim=False):
 
 
 def mode(x, axis=-1, keepdim=False):
-    raise NotImplementedError("paddle.mode: not yet implemented")
+    """Most frequent value along axis (ties -> the larger value, paddle
+    convention); index is the LAST occurrence in the original order."""
+    x = jnp.asarray(x)
+    ax = axis % x.ndim
+    moved = jnp.moveaxis(x, ax, -1)
+    n = moved.shape[-1]
+    xs = jnp.sort(moved, axis=-1)
+    # count[i] = multiplicity of xs[..., i]; O(n^2) compare is fine for
+    # the long-tail op (n = one axis length)
+    counts = jnp.sum(xs[..., :, None] == xs[..., None, :], axis=-1)
+    # ties: prefer later (larger, since sorted) position
+    best = jnp.argmax(counts * n + jnp.arange(n), axis=-1)
+    mode_val = jnp.take_along_axis(xs, best[..., None], -1)[..., 0]
+    is_mode = moved == mode_val[..., None]
+    idx = jnp.argmax(jnp.where(is_mode, jnp.arange(n), -1), axis=-1)
+    if keepdim:
+        mode_val = jnp.expand_dims(mode_val, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return mode_val, idx.astype(jnp.int64)
 
 
 def where(condition, x=None, y=None):
@@ -102,8 +120,22 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
 def unique_consecutive(x, return_inverse=False, return_counts=False,
                        axis=None):
     arr = np.asarray(x)
-    if axis is not None or arr.ndim != 1:
-        raise NotImplementedError("unique_consecutive: only 1-D supported")
+    if axis is None and arr.ndim != 1:
+        arr = arr.reshape(-1)
+    if axis is not None:
+        # compare whole slices along ``axis`` (ND support)
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+        out = [jnp.asarray(np.moveaxis(moved[keep], 0, axis))]
+        if return_inverse:
+            out.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            out.append(jnp.asarray(np.diff(
+                np.append(idx, len(flat)))))
+        return out[0] if len(out) == 1 else tuple(out)
     keep = np.concatenate([[True], arr[1:] != arr[:-1]])
     out = [jnp.asarray(arr[keep])]
     if return_inverse:

@@ -65,6 +65,27 @@ def main(out_dir):
     hcg = fleet.get_hybrid_communicate_group()
     assert hcg.mesh.devices.size == 8
 
+    # eager per-rank collectives (reference contract: each process
+    # contributes its LOCAL value)
+    from paddle_tpu.distributed import collective as coll
+    mine = np.full((3,), float(rank + 1), np.float32)
+    red = coll.all_reduce(mine)                    # 1 + 2 = 3
+    assert np.allclose(np.asarray(red), 3.0), red
+    mx = coll.all_reduce(mine, op=coll.ReduceOp.MAX)
+    assert np.allclose(np.asarray(mx), 2.0), mx
+    bc = coll.broadcast(mine, src=1)
+    assert np.allclose(np.asarray(bc), 2.0), bc
+    gathered = coll.all_gather(mine)
+    assert np.allclose(np.asarray(gathered),
+                       np.repeat([1.0, 2.0], 3)), gathered
+    sub = coll.new_group(ranks=[0])                # subset group
+    sr = coll.all_reduce(mine, group=sub)
+    if rank == 0:
+        assert np.allclose(np.asarray(sr), 1.0), sr    # only own value
+    else:
+        assert np.allclose(np.asarray(sr), 2.0), sr    # non-member: as-is
+    coll.barrier()
+
     if rank == 0:
         with open(os.path.join(out_dir, "result.txt"), "w") as f:
             f.write(f"psum={val} world={dist_env.get_world_size()}")

@@ -1,0 +1,268 @@
+"""Long-tail op coverage (VERDICT round-1 weak #9): numpy-oracle OpTest
+pattern (SURVEY.md §4) for the newly filled-in surface."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+P = paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _np(x):
+    return np.asarray(x.numpy())
+
+
+class TestSearchOps:
+    def test_mode_basic(self):
+        x = np.array([[2, 2, 3], [1, 5, 5]], np.float32)
+        vals, idx = P.mode(_t(x))
+        np.testing.assert_array_equal(_np(vals), [2, 5])
+        np.testing.assert_array_equal(_np(idx), [1, 2])
+
+    def test_mode_tie_prefers_larger(self):
+        x = np.array([1.0, 1.0, 7.0, 7.0], np.float32)
+        vals, _ = P.mode(_t(x))
+        assert float(_np(vals)) == 7.0
+
+    def test_mode_keepdim_matches_scipy(self):
+        from scipy import stats
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 4, size=(5, 11)).astype(np.float32)
+        vals, idx = P.mode(_t(x), axis=1, keepdim=True)
+        assert _np(vals).shape == (5, 1)
+        want = stats.mode(x, axis=1, keepdims=True)
+        # scipy returns the SMALLEST tie; compare counts instead
+        for r in range(5):
+            got_v = _np(vals)[r, 0]
+            cnt_got = np.sum(x[r] == got_v)
+            cnt_want = np.sum(x[r] == want.mode[r, 0])
+            assert cnt_got == cnt_want
+
+    def test_unique_consecutive_nd(self):
+        x = np.array([[1, 1], [1, 1], [2, 3], [1, 1]], np.int64)
+        out, inv, cnt = P.unique_consecutive(
+            _t(x), return_inverse=True, return_counts=True, axis=0)
+        np.testing.assert_array_equal(_np(out),
+                                      [[1, 1], [2, 3], [1, 1]])
+        np.testing.assert_array_equal(_np(cnt), [2, 1, 1])
+
+
+class TestMathOps:
+    def test_diff_cummin_cummax(self):
+        x = np.array([3.0, 1.0, 2.0, 0.5], np.float32)
+        np.testing.assert_allclose(_np(P.diff(_t(x))), np.diff(x))
+        vals, idx = P.cummin(_t(x))
+        np.testing.assert_array_equal(_np(vals), [3, 1, 1, 0.5])
+        np.testing.assert_array_equal(_np(idx), [0, 1, 1, 3])
+        vals, idx = P.cummax(_t(x))
+        np.testing.assert_array_equal(_np(vals), [3, 3, 3, 3])
+        np.testing.assert_array_equal(_np(idx), [0, 0, 0, 0])
+
+    def test_logcumsumexp(self):
+        x = np.linspace(-2, 2, 7).astype(np.float32)
+        want = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(_np(P.logcumsumexp(_t(x))), want,
+                                   rtol=1e-5)
+
+    def test_renorm_caps_norms(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 8)).astype(np.float32) * 5
+        out = _np(P.renorm(_t(x), p=2.0, axis=0, max_norm=1.0))
+        norms = np.linalg.norm(out, axis=1)
+        assert np.all(norms <= 1.0 + 1e-5)
+
+    def test_quantile_nan_variants(self):
+        x = np.array([1.0, np.nan, 3.0, 2.0], np.float32)
+        assert abs(float(_np(P.nanquantile(_t(x), 0.5))) - 2.0) < 1e-6
+        assert abs(float(_np(P.nanmedian(_t(x)))) - 2.0) < 1e-6
+
+    def test_equal_all_hypot(self):
+        a = np.ones((2, 2), np.float32)
+        assert bool(_np(P.equal_all(_t(a), _t(a.copy()))))
+        np.testing.assert_allclose(_np(P.hypot(_t([3.0]), _t([4.0]))),
+                                   [5.0])
+
+
+class TestManipulationOps:
+    def test_scatter_nd(self):
+        idx = np.array([[1], [3]], np.int64)
+        upd = np.array([9.0, 10.0], np.float32)
+        out = _np(P.scatter_nd(_t(idx), _t(upd), [5]))
+        np.testing.assert_array_equal(out, [0, 9, 0, 10, 0])
+
+    def test_masked_scatter(self):
+        x = np.zeros(5, np.float32)
+        m = np.array([0, 1, 0, 1, 1], bool)
+        v = np.array([7.0, 8.0, 9.0, 99.0], np.float32)
+        out = _np(P.masked_scatter(_t(x), _t(m), _t(v)))
+        np.testing.assert_array_equal(out, [0, 7, 0, 8, 9])
+
+    def test_as_strided_view_unflatten_take(self):
+        x = np.arange(12, dtype=np.float32)
+        out = _np(P.as_strided(_t(x), [3, 2], [4, 1]))
+        np.testing.assert_array_equal(out, [[0, 1], [4, 5], [8, 9]])
+        out = _np(P.unflatten(_t(x.reshape(3, 4)), 1, [2, 2]))
+        assert out.shape == (3, 2, 2)
+        np.testing.assert_array_equal(
+            _np(P.take(_t(x.reshape(3, 4)), _t([0, 5, 11]))), [0, 5, 11])
+
+
+class TestNNOps:
+    def test_adaptive_pool_non_divisible(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 2, 7, 5)).astype(np.float32)
+        out = _np(paddle.nn.functional.adaptive_avg_pool2d(_t(x), [3, 2]))
+        assert out.shape == (1, 2, 3, 2)
+        # torch oracle semantics: bin i = [floor(iH/o), ceil((i+1)H/o))
+        want00 = x[0, 0, 0:3, 0:3].mean()
+        np.testing.assert_allclose(out[0, 0, 0, 0], want00, rtol=1e-6)
+        outm = _np(paddle.nn.functional.adaptive_max_pool2d(_t(x), [3, 2]))
+        np.testing.assert_allclose(outm[0, 0, 0, 0],
+                                   x[0, 0, 0:3, 0:3].max(), rtol=1e-6)
+
+    def test_pixel_unshuffle_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        down = paddle.nn.functional.pixel_unshuffle(_t(x), 2)
+        back = paddle.nn.functional.pixel_shuffle(down, 2)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6)
+
+    def test_channel_shuffle(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+        out = _np(paddle.nn.functional.channel_shuffle(_t(x), 2))
+        np.testing.assert_array_equal(out.ravel(),
+                                      [0, 4, 1, 5, 2, 6, 3, 7])
+
+    def test_fold_unfold_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+        cols = paddle.nn.functional.unfold(_t(x), 2, strides=2)
+        back = paddle.nn.functional.fold(cols, [6, 6], 2, strides=2)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-6)
+
+    def test_grid_sample_identity(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 1, 4, 4)).astype(np.float32)
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        grid = paddle.nn.functional.affine_grid(_t(theta), [1, 1, 4, 4])
+        out = _np(paddle.nn.functional.grid_sample(_t(x), grid))
+        np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+    def test_dropout2d_channel_granularity(self):
+        paddle.seed(0)
+        x = np.ones((2, 8, 4, 4), np.float32)
+        out = _np(paddle.nn.functional.dropout2d(_t(x), 0.5,
+                                                 training=True))
+        per_channel = out.reshape(2, 8, -1)
+        for b in range(2):
+            for c in range(8):
+                vals = np.unique(per_channel[b, c])
+                assert len(vals) == 1          # whole channel on or off
+
+    def test_conv_transpose_string_padding(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+        w = rng.normal(size=(2, 3, 3, 3)).astype(np.float32)
+        out = paddle.nn.functional.conv2d_transpose(
+            _t(x), _t(w), stride=1, padding="SAME")
+        assert _np(out).shape == (1, 3, 5, 5)
+        out_v = paddle.nn.functional.conv2d_transpose(
+            _t(x), _t(w), stride=1, padding="VALID")
+        assert _np(out_v).shape == (1, 3, 7, 7)
+
+
+class TestLinalgOps:
+    def test_cdist_pdist(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(5, 3)).astype(np.float32)
+        got = _np(P.cdist(_t(a), _t(b)))
+        want = np.linalg.norm(a[:, None] - b[None], axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        from scipy.spatial.distance import pdist as spdist
+        np.testing.assert_allclose(_np(P.pdist(_t(a))), spdist(a),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lu_reconstructs(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        lu_, piv = P.lu(_t(a))
+        lu_ = _np(lu_)
+        piv0 = _np(piv) - 1           # back to 0-based
+        L = np.tril(lu_, -1) + np.eye(4, dtype=np.float32)
+        U = np.triu(lu_)
+        pa = a.copy()
+        for i, p in enumerate(piv0):
+            pa[[i, p]] = pa[[p, i]]
+        np.testing.assert_allclose(L @ U, pa, rtol=1e-4, atol=1e-4)
+
+    def test_tensordot_vander_histogramdd(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_allclose(
+            _np(P.tensordot(_t(a), _t(b), axes=1)), a @ b)
+        np.testing.assert_allclose(
+            _np(P.vander(_t(np.array([1.0, 2.0, 3.0])))),
+            np.vander([1.0, 2.0, 3.0]))
+        h, edges = P.histogramdd(_t(np.random.default_rng(0)
+                                    .normal(size=(100, 2))
+                                    .astype(np.float32)), bins=4)
+        assert _np(h).sum() == 100 and len(edges) == 2
+
+
+class TestDataLoaderWorkers:
+    def test_multiprocess_workers_preserve_order_and_content(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class D(Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i)
+
+            def __len__(self):
+                return 17
+
+        ld = DataLoader(D(), batch_size=4, num_workers=3, shuffle=False)
+        seen = []
+        for x, y in ld:
+            assert _np(x).shape[1] == 3
+            seen.extend(_np(y).tolist())
+        assert seen == list(range(17))
+
+    def test_worker_error_propagates(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom")
+                return np.zeros(2, np.float32)
+
+            def __len__(self):
+                return 8
+
+        ld = DataLoader(Bad(), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(ld)
+
+    def test_worker_init_fn_runs_in_worker(self, tmp_path):
+        from paddle_tpu.io import DataLoader, Dataset
+        marker = str(tmp_path / "w")
+
+        def init(wid):
+            open(f"{marker}{wid}", "w").write("x")
+
+        class D(Dataset):
+            def __getitem__(self, i):
+                return np.zeros(1, np.float32)
+
+            def __len__(self):
+                return 4
+
+        list(DataLoader(D(), batch_size=2, num_workers=2,
+                        worker_init_fn=init))
+        import os
+        assert os.path.exists(marker + "0")
