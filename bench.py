@@ -150,7 +150,8 @@ def bench_headline(emit=True):
     cfg = LlamaConfig(vocab_size=_VOCAB if on_tpu else 1024, hidden_size=h,
                       intermediate_size=i, num_hidden_layers=layers,
                       num_attention_heads=heads, num_key_value_heads=kv,
-                      max_position_embeddings=seq, recompute=True)
+                      max_position_embeddings=seq, recompute=True,
+                      recompute_granularity="core_attn")
     if not on_tpu:
         n_params = _param_count(h, i, layers, heads, kv, cfg.vocab_size)
 

@@ -113,12 +113,14 @@ def new_group(ranks: Optional[List[int]] = None, backend=None,
         enforce(hcg is not None, "fleet.init() first")
         g = CommGroup(hcg.mesh, tuple([axis] if isinstance(axis, str)
                                       else axis))
-    elif ranks is not None and \
-            sorted(ranks) != list(range(jax.process_count())):
-        g = ProcessSubsetGroup(ranks)
     else:
         hcg = fleet.get_hybrid_communicate_group()
-        if hcg is None and ranks is not None:
+        # "all ranks" in either unit (paddle idiom): process count or
+        # mesh device count -> the default all-ranks group
+        all_ranks = [list(range(jax.process_count()))]
+        if hcg is not None:
+            all_ranks.append(list(range(int(hcg.mesh.devices.size))))
+        if ranks is not None and sorted(ranks) not in all_ranks:
             g = ProcessSubsetGroup(ranks)
         else:
             enforce(hcg is not None, "fleet.init() first")
@@ -240,6 +242,10 @@ _EAGER_REDUCERS = {
 # ---------------------------------------------------------------------------
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True):
+    # a mesh-axis CommGroup passed explicitly keeps single-controller
+    # semantics on concrete values (identity); only the default group or
+    # a ProcessSubsetGroup gets the cross-process eager transport
+    cross_ok = group is None or isinstance(group, ProcessSubsetGroup)
     if not isinstance(group, ProcessSubsetGroup):
         group = group or _default_group()
     val = _unwrap(tensor)
@@ -256,7 +262,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op: bool = True):
             enforce(op in fns, f"unsupported ReduceOp {op!r}")
             out = fns[op](val, group.axis_name)
         return Tensor(out) if isinstance(tensor, Tensor) else out
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and cross_ok:
         # true per-rank semantics across processes (reference contract)
         res = _cross_process(
             val, _EAGER_REDUCERS[op],
@@ -274,7 +280,9 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
     """Both signatures supported: paddle's
     ``all_gather(tensor_list, tensor)`` and functional
     ``out = all_gather(tensor)``."""
-    group = group or _default_group()
+    cross_ok = group is None or isinstance(group, ProcessSubsetGroup)
+    if not isinstance(group, ProcessSubsetGroup):
+        group = group or _default_group()
     if isinstance(tensor_or_list, list) and tensor is not None:
         val = _unwrap(tensor)
         if _is_traced(val):
@@ -282,7 +290,7 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
             n = group.nranks
             tensor_or_list.extend(Tensor(out[i]) for i in range(n))
             return
-        if jax.process_count() > 1:
+        if jax.process_count() > 1 and cross_ok:
             res = _cross_process(
                 val, _gather_stacked,
                 group if isinstance(group, ProcessSubsetGroup) else None,
@@ -297,7 +305,7 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
     if _is_traced(val):
         out = lax.all_gather(val, group.axis_name, tiled=True)
         return Tensor(out) if isinstance(tensor_or_list, Tensor) else out
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and cross_ok:
         res = _cross_process(
             val, _gather_tiled,
             group if isinstance(group, ProcessSubsetGroup) else None,
@@ -345,7 +353,8 @@ alltoall = all_to_all
 
 def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
     val = _unwrap(tensor)
-    if not _is_traced(val) and jax.process_count() > 1:
+    if not _is_traced(val) and jax.process_count() > 1 and (
+            group is None or isinstance(group, ProcessSubsetGroup)):
         pg = group if isinstance(group, ProcessSubsetGroup) \
             else _world_proc_group()
         idx = pg.rank_in_group(src)
@@ -373,7 +382,8 @@ def reduce(tensor, dst: int = 0, op=ReduceOp.SUM,
 
 
 def barrier(group=None):
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and (
+            group is None or isinstance(group, ProcessSubsetGroup)):
         _cross_process(jnp.zeros((1,)), _EAGER_REDUCERS[ReduceOp.SUM],
                        group if isinstance(group, ProcessSubsetGroup)
                        else None, fn_key=("reduce", ReduceOp.SUM))

@@ -10,6 +10,7 @@ tf.data-style prefetch pipelines — same API, TPU-appropriate engine).
 from __future__ import annotations
 
 import itertools
+import time
 import queue
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Sequence
@@ -343,12 +344,28 @@ class DataLoader:
                 _issue()
             pending = {}
             expect = 0
-            timeout = self.timeout or None
+            deadline = (time.monotonic() + self.timeout) \
+                if self.timeout else None
             while expect < len(batches):
                 if expect in pending:
                     items = pending.pop(expect)
                 else:
-                    bid, items, err = res_q.get(timeout=timeout)
+                    try:
+                        bid, items, err = res_q.get(timeout=1.0)
+                    except queue.Empty:
+                        # liveness: a silently-dead worker (OOM kill,
+                        # unpicklable item) must not hang the loop
+                        if not any(p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                "DataLoader workers died without "
+                                "reporting a result (killed? "
+                                "unpicklable sample?)")
+                        if deadline and time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for batch "
+                                f"{expect}")
+                        continue
                     if err is not None:
                         raise RuntimeError(f"DataLoader worker failed: "
                                            f"{err}")

@@ -17,12 +17,26 @@ from ..tensor import Tensor, apply_op
 __all__ = ["recompute"]
 
 
-def recompute(function, *args, **kwargs):
+def _resolve_policy(policy):
+    """None = full remat; "core_attn" keeps tensors tagged "attn_out"
+    (paddle recompute_granularity parity); "dots" keeps matmul outputs;
+    or pass a jax.checkpoint_policies callable directly."""
+    if policy is None or callable(policy):
+        return policy
+    if policy == "core_attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown recompute policy {policy!r}")
+
+
+def recompute(function, *args, policy=None, **kwargs):
     """Run ``function(*args)`` under rematerialization.
 
     Works both eagerly (no-op semantics, correct grads) and inside the
     compiled train step (where it actually saves memory).
     """
+    pol = _resolve_policy(policy)
     layer = function if isinstance(function, Layer) else None
     fn = function.forward if layer is not None else function
 
@@ -39,7 +53,8 @@ def recompute(function, *args, **kwargs):
                 return jax.tree_util.tree_map(
                     lambda t: t.value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
-            return jax.checkpoint(inner)(param_list, *arg_arrays)
+            return jax.checkpoint(inner, policy=pol)(param_list,
+                                                     *arg_arrays)
         raw.__name__ = "recompute"
         return apply_op(raw, [named[n] for n in names], *args)
 
@@ -51,6 +66,6 @@ def recompute(function, *args, **kwargs):
             return jax.tree_util.tree_map(
                 lambda t: t.value if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
-        return jax.checkpoint(inner)(*arg_arrays)
+        return jax.checkpoint(inner, policy=pol)(*arg_arrays)
     raw_fn.__name__ = "recompute"
     return apply_op(raw_fn, *args)

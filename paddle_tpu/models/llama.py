@@ -51,6 +51,7 @@ class LlamaConfig:
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
+    recompute_granularity: str = "full"   # "full" | "core_attn" | "dots"
     fuse_linear_cross_entropy: bool = True  # chunked lm_head+CE (training)
 
 
@@ -225,7 +226,11 @@ class LlamaDecoderLayer(Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x), cos_sin)
+        attn = self.self_attn(self.input_layernorm(x), cos_sin)
+        # named residual for selective remat (recompute_granularity
+        # "core_attn": keep the flash output, recompute the cheap rest)
+        attn = apply_op(_ckpt_name_attn, attn)
+        x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -285,7 +290,9 @@ class LlamaModel(Layer):
                 new_caches.append(c)
             elif self.config.recompute:
                 from ..jit.recompute import recompute
-                x = recompute(layer, x, cos_sin)
+                gran = self.config.recompute_granularity
+                x = recompute(layer, x, cos_sin,
+                              policy=None if gran == "full" else gran)
             else:
                 x = layer(x, cos_sin)
         x = self.norm(x)
@@ -389,6 +396,11 @@ def _attn_for_shape(q, k, v):
     return _nn.scaled_dot_product_attention(q, k, v, is_causal=True)
 
 
+def _ckpt_name_attn(a):
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(a, "attn_out")
+
+
 def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
     """One Llama decoder layer on raw arrays (mirrors LlamaDecoderLayer;
     kept in sync by the pipe-vs-sequential parity test)."""
@@ -403,6 +415,7 @@ def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
     v = jnp.matmul(hn, vw).reshape(b, s, n_kv, head_dim)
     q, k = _apply_rope_raw(q, k, cos, sin)
     attn = _attn_for_shape(q, k, v).reshape(b, s, n_heads * head_dim)
+    attn = _ckpt_name_attn(attn)
     h = h + jnp.matmul(attn, ow)
     hn = _nn.rms_norm(h, pln, epsilon=eps)
     ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)

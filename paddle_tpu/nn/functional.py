@@ -71,20 +71,34 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                 causal=is_causal)
             except NotImplementedError:
                 pass  # shape not sep-shardable; plain paths below
+    from ..tensor import Tensor as _T
+    # a TRAINED attention bias must take the jnp path: the pallas masked
+    # kernel treats the mask as a constant (zero gradient)
+    mask_trainable = (isinstance(attn_mask, _T)
+                      and not attn_mask.stop_gradient)
     use_pallas = (
         get_flag("use_pallas")
-        and attn_mask is None
         and dropout_p == 0.0
+        and not mask_trainable
         and is_compiled_with_tpu()
     )
     if use_pallas:
         kernel = _flash_kernel()
         if kernel is not None:
+            mask = attn_mask
+            if mask is not None:
+                mval = mask.value if isinstance(mask, _T) else jnp.asarray(
+                    mask)
+                # bool masks (True = attend) become additive -inf bias
+                if mval.dtype == jnp.bool_:
+                    mval = jnp.where(mval, 0.0, -1e30).astype(jnp.float32)
+                mask = mval
             try:
                 # NotImplementedError is the kernel's documented "shape not
                 # covered" signal; anything else is a real bug and must
                 # propagate (ADVICE.md round-1)
-                return apply_op(kernel, query, key, value, causal=is_causal)
+                return apply_op(kernel, query, key, value, causal=is_causal,
+                                mask=mask)
             except NotImplementedError:
                 pass
     return _api.scaled_dot_product_attention(

@@ -151,7 +151,9 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
     [..., N, D] (MXU path for p=2: the |x|^2 - 2xy + |y|^2 expansion)."""
     x = jnp.asarray(x)
     y = jnp.asarray(y)
-    if p == 2.0 and "use_mm" in str(compute_mode):
+    if p == 2.0 and str(compute_mode) in (
+            "use_mm_for_euclid_dist_if_necessary",
+            "use_mm_for_euclid_dist"):
         x2 = jnp.sum(x * x, -1)[..., :, None]
         y2 = jnp.sum(y * y, -1)[..., None, :]
         xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))

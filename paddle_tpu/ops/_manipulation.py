@@ -332,9 +332,15 @@ def view(x, shape_or_dtype):
         return jnp.reshape(x, [int(s) for s in shape_or_dtype])
     from ..common.dtype import convert_dtype
     dt = convert_dtype(shape_or_dtype)
-    out = jax.lax.bitcast_convert_type(x, dt)
     # paddle contract: the LAST dim absorbs the itemsize ratio (lax
     # appends/consumes a trailing ratio dim instead)
+    in_size = x.dtype.itemsize
+    out_size = jnp.dtype(dt).itemsize
+    if out_size > in_size:              # widening: split last dim first
+        ratio = out_size // in_size
+        x = jnp.reshape(x, x.shape[:-1] + (x.shape[-1] // ratio, ratio))
+        return jax.lax.bitcast_convert_type(x, dt)
+    out = jax.lax.bitcast_convert_type(x, dt)
     if out.ndim == x.ndim + 1:          # narrowing: fold trailing dim
         return out.reshape(out.shape[:-2] + (-1,))
     return out

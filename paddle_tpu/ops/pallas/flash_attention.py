@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention_raw", "flash_attention_bhsd"]
+__all__ = ["flash_attention_raw", "flash_attention_bhsd",
+           "flash_attention_bhsd_masked"]
 
 _NEG_INF = float(-1e30)
 _LANES = 128  # m/l scratch broadcast across one lane tile
@@ -45,8 +46,13 @@ def _pick_blocks(sq: int, sk: int):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk, nk,
+                off, has_mask=False):
+    if has_mask:
+        mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -68,6 +74,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_mask:
+            s = s + mask_ref[0, 0].astype(jnp.float32)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -98,7 +106,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
+def _mask_spec(mask, bq, bk, grid_kind, group=1):
+    """BlockSpec for an additive mask [B|1, H|1, Sq|1, Sk] — broadcast
+    dims pin their block index to 0."""
+    mb, mh, msq, _ = mask.shape
+    blk = (1, 1, bq if msq > 1 else 1, bk)
+    if grid_kind == "q":         # grid (b, h, iq, ik)
+        def imap(b_, h_, iq, ik):
+            return (b_ if mb > 1 else 0, h_ if mh > 1 else 0,
+                    iq if msq > 1 else 0, ik)
+    else:                        # "kv": grid (b, hk, ik, g, iq)
+        def imap(b_, hk_, ik, g_, iq):
+            return (b_ if mb > 1 else 0,
+                    (hk_ * group + g_) if mh > 1 else 0,
+                    iq if msq > 1 else 0, ik)
+    return pl.BlockSpec(blk, imap)
+
+
+def _fwd(q, k, v, *, causal: bool, bq: int, bk: int, mask=None):
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = h // hk
@@ -107,17 +132,23 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
     off = sk - sq
 
     grid = (b, h, nq, nk)
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=off),
-        grid=grid,
-        in_specs=[
+    in_specs = [
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-        ],
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(_mask_spec(mask, bq, bk, "q"))
+        args.append(mask)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, off=off,
+                          has_mask=mask is not None),
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, bq, 8),
@@ -132,7 +163,7 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
@@ -141,7 +172,13 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk, off):
+                   *rest, scale, causal, bq, bk, nk, off,
+                   has_mask=False):
+    if has_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        mask_ref = None
+        dq_ref, dq_scr = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -162,6 +199,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, :1]                        # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_mask:
+            s = s + mask_ref[0, 0].astype(jnp.float32)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -185,11 +224,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bk, nq, off):
-    ik, iq = pl.program_id(2), pl.program_id(3)
+                    *rest, scale, causal, bq, bk, nq, group, off,
+                    has_mask=False):
+    """Grid (b, hk, ik, g, iq): dK/dV accumulate in scratch across BOTH
+    the query-head group and the Q stream, flushing once per KV head —
+    no full-query-head dK/dV materialization + sum (the round-1 GQA
+    memory overhead)."""
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+    ik, g, iq = pl.program_id(2), pl.program_id(3), pl.program_id(4)
 
-    @pl.when(iq == 0)
+    @pl.when((iq == 0) & (g == 0))
     def _():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -208,6 +256,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if has_mask:
+            s = s + mask_ref[0, 0].astype(jnp.float32)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -224,14 +274,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, d]
 
-    @pl.when(iq == nq - 1)
+    @pl.when((iq == nq - 1) & (g == group - 1))
     def _():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, bq, bk, res, do):
-    q, k, v, out, lse = res
+def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None):
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = h // hk
@@ -243,63 +292,85 @@ def _bwd(causal, bq, bk, res, do):
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
     off = sk - sq
 
+    dq_specs = [
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if mask is not None:
+        dq_specs.append(_mask_spec(mask, bq, bk, "q"))
+        dq_args.append(mask)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, off=off),
+                          bq=bq, bk=bk, nk=nk, off=off,
+                          has_mask=mask is not None),
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 8),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 8),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
-    # dk/dv per query head; GQA group-sum happens below
+    # dk/dv at KV-head granularity: grid (b, hk, ik, g, iq) accumulates
+    # the whole query-head group into one [bk, d] scratch before flushing
+    dkv_specs = [
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b_, hk_, ik, g_, iq, G=group:
+                         (b_, hk_ * G + g_, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda b_, hk_, ik, g_, iq, G=group:
+                         (b_, hk_ * G + g_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, hk_, ik, g_, iq, G=group:
+                         (b_, hk_ * G + g_, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 8),
+                         lambda b_, hk_, ik, g_, iq, G=group:
+                         (b_, hk_ * G + g_, iq, 0)),
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    if mask is not None:
+        dkv_specs.append(_mask_spec(mask, bq, bk, "kv", group))
+        dkv_args.append(mask)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, off=off),
-        grid=(b, h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, ik, iq, g=group: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, ik, iq, g=group: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 8),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 8),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-        ],
+                          bq=bq, bk=bk, nq=nq, group=group, off=off,
+                          has_mask=mask is not None),
+        grid=(b, hk, nk, group, nq),
+        in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b, hk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hk, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-    )(q, k, v, do, lse, delta)
-
-    if group > 1:
-        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2).astype(k.dtype)
-        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2).astype(v.dtype)
+    )(*dkv_args)
     return dq, dk, dv
+
+
+def _bwd(causal, bq, bk, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, do, causal=causal, bq=bq, bk=bk)
 
 
 # ---------------------------------------------------------------------------
@@ -321,11 +392,37 @@ def _fwd_rule(q, k, v, causal, bq, bk):
 flash_attention_bhsd.defvjp(_fwd_rule, _bwd)
 
 
-def flash_attention_raw(q, k, v, causal: bool = False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_bhsd_masked(q, k, v, mask, causal: bool, bq: int,
+                                bk: int):
+    """[B, H, S, D] flash attention with an additive mask
+    [B|1, H|1, Sq|1, Sk] (padding masks, ALiBi biases, block masks)."""
+    out, _ = _fwd(q, k, v, causal=causal, bq=bq, bk=bk, mask=mask)
+    return out
+
+
+def _masked_fwd_rule(q, k, v, mask, causal, bq, bk):
+    out, lse = _fwd(q, k, v, causal=causal, bq=bq, bk=bk, mask=mask)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _masked_bwd(causal, bq, bk, res, do):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, out, lse, do, causal=causal, bq=bq,
+                           bk=bk, mask=mask)
+    # attention masks/biases are inputs, not trained parameters
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+flash_attention_bhsd_masked.defvjp(_masked_fwd_rule, _masked_bwd)
+
+
+def flash_attention_raw(q, k, v, causal: bool = False, mask=None):
     """[B, S, H, D] entry used by F.scaled_dot_product_attention.
 
     Causal with sq < sk treats Q as the LAST sq positions (KV-cache
-    decode / chunked prefill).  Raises on shapes the kernel does not
+    decode / chunked prefill).  ``mask`` is an ADDITIVE bias broadcast
+    as [B|1, H|1, Sq|1, Sk].  Raises on shapes the kernel does not
     cover (caller falls back to the jnp reference): sq > sk causal,
     tiny/odd dims.
     """
@@ -339,5 +436,18 @@ def flash_attention_raw(q, k, v, causal: bool = False):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        while mask.ndim < 4:
+            mask = mask[None]
+        mb, mh, msq, msk = mask.shape
+        if (msk != sk or mb not in (1, b) or mh not in (1, h)
+                or msq not in (1, sq)):
+            raise NotImplementedError(
+                f"flash mask shape {mask.shape} not broadcastable to "
+                f"[{b},{h},{sq},{sk}]")
+        out = flash_attention_bhsd_masked(qt, kt, vt, mask, causal, bq,
+                                          bk)
+        return jnp.swapaxes(out, 1, 2)
     out = flash_attention_bhsd(qt, kt, vt, causal, bq, bk)
     return jnp.swapaxes(out, 1, 2)
