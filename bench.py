@@ -378,10 +378,50 @@ def bench_decode():
                       "per_seq_tokens_per_sec": round(new / dt, 1)}}
 
 
+def bench_longseq():
+    """Long-context row: 32k-token sequences on ONE chip (flash attention
+    + selective remat + fused CE keep the S^2 and vocab terms off HBM).
+    Multi-chip context parallelism (ring/Ulysses over sep) is validated
+    functionally in tests/test_context_parallel.py; this row evidences
+    the single-chip long-seq capability envelope (SURVEY.md §5
+    long-context)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.train import CompiledTrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    dev, kind, peak, hbm, on_tpu = _device()
+    seq = 32768 if on_tpu else 512
+    h, i, layers, heads, kv = 1024, 4096, 12, 8, 4       # llama-410m
+    cfg = LlamaConfig(vocab_size=_VOCAB if on_tpu else 512, hidden_size=h,
+                      intermediate_size=i, num_hidden_layers=layers,
+                      num_attention_heads=heads, num_key_value_heads=kv,
+                      max_position_embeddings=seq, recompute=True)
+    model = paddle.amp.decorate(LlamaForCausalLM(cfg), level="O2",
+                                dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = CompiledTrainStep(model, lambda m, b: m(b["input_ids"],
+                                                   labels=b["labels"]), opt)
+    data = _train_batch(cfg.vocab_size, 1, seq)
+    step_time, loss = _time_step(step, data, 10 if on_tpu else 2)
+    n = _param_count(h, i, layers, heads, kv, cfg.vocab_size)
+    tps = seq / step_time
+    mfu6n, mfu_attn = _mfu_pair(n, layers, h, seq, tps, peak)
+    return {"metric": "llama-410m_seq32k_tokens_per_sec_per_chip",
+            "unit": "tokens/sec", "value": round(tps, 1),
+            "extra": {"device_kind": kind, "seq": seq, "batch": 1,
+                      "params": n,
+                      "mfu": round(mfu6n, 4) if mfu6n else None,
+                      "mfu_attn": round(mfu_attn, 4) if mfu_attn else None,
+                      "final_loss": float(np.asarray(jax.device_get(loss)))}}
+
+
 def main():
     if "--ladder" in sys.argv:
         rows = [bench_headline(emit=False), bench_gpt2(), bench_ernie(),
-                bench_dit(), bench_moe(), bench_decode()]
+                bench_dit(), bench_moe(), bench_decode(), bench_longseq()]
         for r in rows:
             print(json.dumps(r))
         return
