@@ -39,6 +39,7 @@ from . import hapi
 from .hapi import Model
 from .hapi import callbacks
 from . import inference
+from . import vision
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

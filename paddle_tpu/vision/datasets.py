@@ -1,0 +1,198 @@
+"""paddle.vision.datasets parity: MNIST/FashionMNIST (idx files),
+Cifar10/Cifar100 (pickle batches), ImageFolder/DatasetFolder, FakeData.
+
+Zero-egress environment: constructors take local paths (`image_path`/
+`label_path`/`data_file`) and raise a clear error when the files are
+absent instead of downloading (the reference downloads on demand).
+FakeData generates deterministic synthetic samples for pipeline tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.errors import enforce
+from ..io.dataloader import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "FakeData"]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2"):
+        enforce(image_path and label_path,
+                "MNIST: pass image_path/label_path to local idx(.gz) files "
+                "(no network in this environment)")
+        self.images = _read_idx(image_path)          # [N, 28, 28]
+        self.labels = _read_idx(label_path).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, i):
+        img = self.images[i][:, :, None]             # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    _train_names = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_names = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2"):
+        enforce(data_file, "Cifar: pass data_file (the local .tar.gz) — "
+                           "no network in this environment")
+        names = self._train_names if mode == "train" else self._test_names
+        imgs, labels = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"]))
+                    labels.extend(d[self._label_key])
+        enforce(imgs, f"no {names} members in {data_file}")
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.images = np.transpose(self.images, (0, 2, 3, 1))   # HWC
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _train_names = ["train"]
+    _test_names = ["test"]
+    _label_key = b"fine_labels"
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (paddle DatasetFolder)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=None, transform: Optional[Callable] = None,
+                 is_valid_file: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        exts = tuple(extensions) if extensions else _IMG_EXTS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        enforce(classes, f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(base, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """flat (unlabelled) image folder: returns [img]."""
+
+    def __init__(self, root: str, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        exts = tuple(extensions) if extensions else _IMG_EXTS
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, i):
+        img = self.loader(self.samples[i])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (pipeline/perf tests)."""
+
+    def __init__(self, size: int = 100, image_shape=(3, 224, 224),
+                 num_classes: int = 10,
+                 transform: Optional[Callable] = None, seed: int = 0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(self.seed + i)
+        img = rng.normal(size=self.image_shape).astype(np.float32)
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size

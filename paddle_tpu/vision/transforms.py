@@ -1,0 +1,385 @@
+"""paddle.vision.transforms parity (python/paddle/vision/transforms).
+
+Host-side preprocessing on PIL Images / numpy HWC arrays — transforms
+run in DataLoader workers (CPU), never on the TPU step path, so plain
+numpy/PIL is the right tool (the reference's are cv2/PIL too).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomResizedCrop", "Pad", "Grayscale",
+           "RandomRotation", "BrightnessTransform", "ContrastTransform",
+           "Transpose", "to_tensor", "normalize", "resize", "hflip",
+           "vflip", "crop", "center_crop"]
+
+
+def _is_pil(img):
+    try:
+        from PIL import Image
+        return isinstance(img, Image.Image)
+    except ImportError:
+        return False
+
+
+def _to_np(img) -> np.ndarray:
+    """-> HWC uint8/float numpy."""
+    if _is_pil(img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _to_pil(arr: np.ndarray):
+    from PIL import Image
+    if arr.shape[-1] == 1:
+        return Image.fromarray(arr[:, :, 0])
+    return Image.fromarray(arr)
+
+
+# -- functional --------------------------------------------------------------
+
+def to_tensor(img, data_format="CHW"):
+    raw = _to_np(img)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:        # dtype-based, like the reference —
+        arr = arr / 255.0            # never rescale float inputs
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    from ..tensor import to_tensor as _tt
+    return _tt(np.ascontiguousarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ..tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = np.asarray(img.numpy())
+    else:
+        arr = _to_np(img).astype(np.float32)
+    mean = np.atleast_1d(np.asarray(mean, np.float32))
+    std = np.atleast_1d(np.asarray(std, np.float32))
+    c = arr.shape[0] if data_format == "CHW" else arr.shape[-1]
+    if len(mean) not in (1, c) or len(std) not in (1, c):
+        raise ValueError(
+            f"normalize: {len(mean)}-element mean/std vs {c} channels "
+            f"(broadcasting would silently change the channel count)")
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    if isinstance(img, Tensor):
+        from ..tensor import to_tensor as _tt
+        return _tt(arr.astype(np.float32))
+    return arr
+
+
+def _pil_size(size, w, h):
+    if isinstance(size, int):
+        if w < h:
+            return (size, int(size * h / w))
+        return (int(size * w / h), size)
+    return (size[1], size[0])          # paddle (h, w) -> PIL (w, h)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from PIL import Image
+    modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+             "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}
+    if _is_pil(img):
+        out = img.resize(_pil_size(size, *img.size), modes[interpolation])
+        return out
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    tgt = _pil_size(size, w, h)
+    if arr.dtype == np.uint8:
+        return _to_np(_to_pil(arr).resize(tgt, modes[interpolation]))
+    # float data: per-channel 32-bit-float PIL resize (a uint8 cast
+    # would wrap negatives / truncate [0,1] data to zeros)
+    chans = [np.asarray(Image.fromarray(arr[:, :, c].astype(np.float32),
+                                        mode="F")
+                        .resize(tgt, modes[interpolation]))
+             for c in range(arr.shape[-1])]
+    return np.stack(chans, axis=-1).astype(arr.dtype)
+
+
+def hflip(img):
+    if _is_pil(img):
+        from PIL import Image
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return _to_np(img)[:, ::-1]
+
+
+def vflip(img):
+    if _is_pil(img):
+        from PIL import Image
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    return _to_np(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    return _to_np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(img, top, left, th, tw)
+
+
+# -- transform classes -------------------------------------------------------
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean]            # length-1 broadcasts to ANY C
+        if isinstance(std, numbers.Number):
+            std = [std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else (self.padding,) * 4
+            if len(p) == 2:            # (pad_lr, pad_tb), paddle form
+                p = (p[0], p[1], p[0], p[1])
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = np.pad(arr, ((0, max(0, th - h)), (0, max(0, tw - w)),
+                               (0, 0)))
+            h, w = arr.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return arr[top:top + th, left:left + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                patch = arr[top:top + ch, left:left + cw]
+                return resize(patch, self.size, self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+        if len(p) == 2:
+            p = (p[0], p[1], p[0], p[1])
+        self.padding = p
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _to_np(img)
+        l, t, r, b = self.padding
+        if self.mode == "constant":
+            return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                      mode={"reflect": "reflect", "edge": "edge",
+                            "symmetric": "symmetric"}[self.mode])
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_np(img).astype(np.float32)
+        if arr.shape[-1] >= 3:
+            g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                 + 0.114 * arr[..., 2])
+        else:
+            g = arr[..., 0]
+        out = np.repeat(g[..., None], self.n, axis=-1)
+        return out.astype(np.uint8) if _to_np(img).dtype == np.uint8 \
+            else out
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from PIL import Image
+        modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                 "bicubic": Image.BICUBIC}
+        pil = img if _is_pil(img) else _to_pil(_to_np(img).astype(np.uint8))
+        angle = random.uniform(*self.degrees)
+        fill = self.fill
+        if isinstance(fill, numbers.Number) and pil.mode == "RGB":
+            fill = (int(fill),) * 3
+        out = pil.rotate(angle, resample=modes[self.interpolation],
+                         expand=self.expand, center=self.center,
+                         fillcolor=fill)
+        return out if _is_pil(img) else _to_np(out)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        raw = _to_np(img)
+        arr = raw.astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = arr * f
+        if raw.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(raw.dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        raw = _to_np(img)
+        arr = raw.astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        out = (arr - mean) * f + mean
+        if raw.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(raw.dtype)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_to_np(img), self.order)

@@ -1,0 +1,217 @@
+"""paddle.vision subsystem: transforms, datasets, models (SURVEY.md
+§2.2 vision row).  Models train e2e (loss decreases) on FakeData."""
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision import FakeData, LeNet, resnet18, transforms as T
+from paddle_tpu.vision.datasets import Cifar10, DatasetFolder, MNIST
+
+
+class TestTransforms:
+    def test_to_tensor_and_normalize(self):
+        img = (np.arange(2 * 3 * 3) % 255).astype(np.uint8).reshape(3, 3, 2)
+        t = T.ToTensor()(img)
+        assert tuple(t.shape) == (2, 3, 3)
+        assert float(t.numpy().max()) <= 1.0
+        n = T.Normalize(mean=[0.5, 0.5], std=[0.5, 0.5])(t)
+        np.testing.assert_allclose(np.asarray(n.numpy()),
+                                   (np.asarray(t.numpy()) - 0.5) / 0.5,
+                                   rtol=1e-6)
+
+    def test_resize_center_crop(self):
+        img = np.zeros((10, 20, 3), np.uint8)
+        out = T.Resize((5, 8))(img)
+        assert out.shape[:2] == (5, 8)
+        out = T.CenterCrop(6)(img)
+        assert out.shape[:2] == (6, 6)
+
+    def test_random_crop_flip_compose(self):
+        import random
+        random.seed(0)
+        img = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+        pipeline = T.Compose([T.RandomCrop(4), T.RandomHorizontalFlip(1.0),
+                              T.ToTensor()])
+        out = pipeline(img)
+        assert tuple(out.shape) == (3, 4, 4)
+        # flip with prob=1 must actually flip
+        flipped = T.RandomHorizontalFlip(1.0)(img)
+        np.testing.assert_array_equal(np.asarray(flipped),
+                                      img[:, ::-1])
+
+    def test_pad_grayscale(self):
+        img = np.full((4, 4, 3), 100, np.uint8)
+        out = T.Pad(2)(img)
+        assert out.shape[:2] == (8, 8) and out[0, 0, 0] == 0
+        g = T.Grayscale(3)(img)
+        assert g.shape == (4, 4, 3)
+        np.testing.assert_allclose(g[0, 0], 100, atol=1)
+
+
+class TestDatasets:
+    def test_mnist_idx_files(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, size=(20, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=(20,), dtype=np.uint8)
+        ip = str(tmp_path / "imgs.idx")
+        lp = str(tmp_path / "lbls.idx")
+        with open(ip, "wb") as f:
+            f.write(struct.pack(">I", 0x00000803))
+            for d in imgs.shape:
+                f.write(struct.pack(">I", d))
+            f.write(imgs.tobytes())
+        with open(lp, "wb") as f:
+            f.write(struct.pack(">I", 0x00000801))
+            f.write(struct.pack(">I", 20))
+            f.write(labels.tobytes())
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 20
+        img, lab = ds[3]
+        assert img.shape == (28, 28, 1) and lab == labels[3]
+
+    def test_cifar10_tarball(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = {b"data": rng.integers(0, 255, size=(10, 3072),
+                                      dtype=np.uint8).astype(np.uint8),
+                b"labels": list(rng.integers(0, 10, size=10))}
+        tar_path = str(tmp_path / "cifar.tar.gz")
+        blob = pickle.dumps(data)
+        with tarfile.open(tar_path, "w:gz") as tar:
+            import io
+            info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+        ds = Cifar10(data_file=tar_path, mode="train")
+        assert len(ds) == 10
+        img, lab = ds[0]
+        assert img.shape == (32, 32, 3)
+
+    def test_dataset_folder(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    np.zeros((8, 8, 3), np.uint8)).save(d / f"{i}.png")
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, target = ds[5]
+        assert target == 1
+
+    def test_fake_data_deterministic(self):
+        a = FakeData(size=4, image_shape=(3, 8, 8))
+        b = FakeData(size=4, image_shape=(3, 8, 8))
+        np.testing.assert_array_equal(a[2][0], b[2][0])
+
+
+class TestModels:
+    def test_lenet_trains(self):
+        paddle.seed(0)
+        model = LeNet(num_classes=10)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        crit = nn.CrossEntropyLoss()
+        from paddle_tpu.jit.train import CompiledTrainStep
+        step = CompiledTrainStep(
+            model, lambda m, b: crit(m(b["x"]), b["y"]), opt)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 1, 28, 28)).astype(np.float32)
+        y = rng.integers(0, 10, size=(8,))
+        losses = [float(np.asarray(step({"x": x, "y": y})))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_resnet18_forward_and_train_step(self):
+        paddle.seed(0)
+        model = paddle.vision.resnet18(num_classes=4)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 32, 32)).astype(np.float32))
+        model.eval()
+        out = model(x)
+        assert tuple(out.shape) == (2, 4)
+
+        model.train()
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=model.parameters())
+        crit = nn.CrossEntropyLoss()
+        from paddle_tpu.jit.train import CompiledTrainStep
+        step = CompiledTrainStep(
+            model, lambda m, b: crit(m(b["x"]), b["y"]), opt)
+        rng = np.random.default_rng(1)
+        xb = rng.normal(size=(4, 3, 32, 32)).astype(np.float32)
+        yb = rng.integers(0, 4, size=(4,))
+        losses = [float(np.asarray(step({"x": xb, "y": yb})))
+                  for _ in range(5)]
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    def test_resnet50_shapes(self):
+        paddle.seed(0)
+        model = paddle.vision.resnet50(num_classes=7)
+        model.eval()
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        assert tuple(model(x).shape) == (1, 7)
+
+    def test_hapi_fit_on_fakedata(self):
+        paddle.seed(0)
+        model = paddle.Model(LeNet(num_classes=4))
+        model.prepare(
+            optimizer=optimizer.AdamW(
+                learning_rate=1e-3,
+                parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy())
+        data = FakeData(size=32, image_shape=(1, 28, 28), num_classes=4)
+        model.fit(data, batch_size=16, epochs=2, verbose=0)
+        res = model.evaluate(data, batch_size=16, verbose=0)
+        assert "acc" in res and np.isfinite(res["loss"])
+
+
+class TestTransformEdgeCases:
+    def test_resize_preserves_float(self):
+        rng = np.random.default_rng(0)
+        img = rng.normal(size=(8, 8, 3)).astype(np.float32)
+        out = T.Resize((4, 4))(img)
+        assert out.dtype == np.float32
+        assert out.min() < 0          # negatives survive (no uint8 wrap)
+        np.testing.assert_allclose(out.mean(), img.mean(), atol=0.3)
+
+    def test_to_tensor_dtype_based_scaling(self):
+        dark = np.ones((4, 4, 1), np.uint8)         # max pixel 1
+        t = T.ToTensor()(dark)
+        np.testing.assert_allclose(np.asarray(t.numpy()), 1 / 255.0,
+                                   rtol=1e-6)
+        f = np.full((4, 4, 1), 200.0, np.float32)   # float: untouched
+        t2 = T.ToTensor()(f)
+        np.testing.assert_allclose(np.asarray(t2.numpy()), 200.0)
+
+    def test_normalize_scalar_keeps_channels(self):
+        x = T.ToTensor()(np.zeros((4, 4, 1), np.uint8))
+        out = T.Normalize(mean=0.5, std=0.5)(x)
+        assert tuple(out.shape) == (1, 4, 4)
+        with pytest.raises(ValueError):
+            T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)(x)
+
+    def test_random_crop_two_tuple_padding(self):
+        import random
+        random.seed(0)
+        img = np.zeros((4, 4, 3), np.uint8)
+        out = T.RandomCrop(6, padding=(1, 2))(img)   # lr=1, tb=2
+        assert out.shape[:2] == (6, 6)
+
+    def test_brightness_float_passthrough(self):
+        img = np.full((4, 4, 3), 0.5, np.float32)
+        out = T.BrightnessTransform(0.2)(img)
+        assert out.dtype == np.float32
+        assert 0.3 < out.mean() < 0.7               # not collapsed to 0/1
+
+    def test_vision_exports(self):
+        assert callable(paddle.vision.resnet101)
+        assert paddle.vision.VGG is not None
