@@ -40,6 +40,8 @@ from .hapi import Model
 from .hapi import callbacks
 from . import inference
 from . import vision
+from . import sparse
+from . import audio
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

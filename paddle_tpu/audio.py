@@ -1,0 +1,203 @@
+"""paddle.audio — spectral feature functions (python/paddle/audio
+parity, SURVEY.md §2.2 row).
+
+TPU-native: STFT/mel features are jnp FFT + matmul (XLA lowers FFT to
+the TPU FFT unit; the mel filterbank matmul rides the MXU).  The
+``features`` layers mirror paddle.audio.features.{Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC}.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .nn.layer import Layer
+from .tensor import Tensor, apply_op
+
+__all__ = ["functional", "features"]
+
+
+class functional:
+    """paddle.audio.functional namespace."""
+
+    @staticmethod
+    def hz_to_mel(f, htk: bool = False):
+        f = np.asarray(f, np.float64)
+        if htk:
+            return 2595.0 * np.log10(1.0 + f / 700.0)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(
+                            np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mels)
+
+    @staticmethod
+    def mel_to_hz(m, htk: bool = False):
+        m = np.asarray(m, np.float64)
+        if htk:
+            return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                        freqs)
+
+    @staticmethod
+    def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                             f_min: float = 0.0,
+                             f_max: Optional[float] = None,
+                             htk: bool = False, norm: str = "slaney"):
+        """[n_mels, n_fft//2+1] mel filterbank (librosa/paddle slaney)."""
+        f_max = f_max or sr / 2.0
+        fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+        mel_pts = np.linspace(functional.hz_to_mel(f_min, htk),
+                              functional.hz_to_mel(f_max, htk), n_mels + 2)
+        hz_pts = functional.mel_to_hz(mel_pts, htk)
+        fb = np.zeros((n_mels, len(fft_freqs)))
+        for i in range(n_mels):
+            lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+            up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+            down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+            fb[i] = np.maximum(0.0, np.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+            fb *= enorm[:, None]
+        return fb.astype(np.float32)
+
+    @staticmethod
+    def get_window(window: str, win_length: int, fftbins: bool = True):
+        n = win_length
+        if window == "hann":
+            w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+        elif window == "hamming":
+            w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+        elif window == "blackman":
+            w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+        return w.astype(np.float32)
+
+    @staticmethod
+    def power_to_db(s, ref_value: float = 1.0, amin: float = 1e-10,
+                    top_db: Optional[float] = 80.0):
+        import jax.numpy as jnp
+
+        def raw(x):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+            log_spec = log_spec - 10.0 * math.log10(
+                max(amin, ref_value))
+            if top_db is not None:
+                log_spec = jnp.maximum(log_spec,
+                                       jnp.max(log_spec) - top_db)
+            return log_spec
+        return apply_op(raw, s) if isinstance(s, Tensor) else raw(s)
+
+
+def _stft_power(x, n_fft, hop, win, power):
+    """x: [..., T] -> [..., n_fft//2+1, frames] power spectrogram."""
+    import jax.numpy as jnp
+    pad = n_fft // 2
+    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode="reflect")
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop
+    starts = jnp.arange(n_frames) * hop
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = x[..., idx] * win                       # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)                 # [..., bins, frames]
+
+
+class features:
+    """paddle.audio.features namespace (Layer-based extractors)."""
+
+    class Spectrogram(Layer):
+        def __init__(self, n_fft: int = 512,
+                     hop_length: Optional[int] = None,
+                     win_length: Optional[int] = None,
+                     window: str = "hann", power: float = 2.0,
+                     center: bool = True, pad_mode: str = "reflect",
+                     dtype: str = "float32"):
+            super().__init__()
+            self.n_fft = n_fft
+            self.hop = hop_length or n_fft // 4
+            self.power = power
+            wl = win_length or n_fft
+            w = functional.get_window(window, wl)
+            if wl < n_fft:                       # center-pad the window
+                lp = (n_fft - wl) // 2
+                w = np.pad(w, (lp, n_fft - wl - lp))
+            self._win = w
+
+        def forward(self, x):
+            win = self._win
+            return apply_op(
+                lambda a: _stft_power(a, self.n_fft, self.hop, win,
+                                      self.power), x)
+
+    class MelSpectrogram(Layer):
+        def __init__(self, sr: int = 22050, n_fft: int = 512,
+                     hop_length: Optional[int] = None,
+                     win_length: Optional[int] = None,
+                     window: str = "hann", power: float = 2.0,
+                     n_mels: int = 64, f_min: float = 50.0,
+                     f_max: Optional[float] = None, htk: bool = False,
+                     norm: str = "slaney", dtype: str = "float32"):
+            super().__init__()
+            self.spectrogram = features.Spectrogram(
+                n_fft, hop_length, win_length, window, power)
+            self._fbank = functional.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+        def forward(self, x):
+            spec = self.spectrogram(x)           # [..., bins, frames]
+            fb = self._fbank
+            return apply_op(
+                lambda s: __import__("jax.numpy", fromlist=["x"]).einsum(
+                    "mf,...ft->...mt", fb, s), spec)
+
+    class LogMelSpectrogram(Layer):
+        def __init__(self, sr: int = 22050, n_fft: int = 512,
+                     hop_length: Optional[int] = None, n_mels: int = 64,
+                     ref_value: float = 1.0, amin: float = 1e-10,
+                     top_db: Optional[float] = None, **kwargs):
+            super().__init__()
+            self.mel = features.MelSpectrogram(
+                sr=sr, n_fft=n_fft, hop_length=hop_length,
+                n_mels=n_mels, **kwargs)
+            self.ref_value, self.amin, self.top_db = ref_value, amin, \
+                top_db
+
+        def forward(self, x):
+            return functional.power_to_db(self.mel(x), self.ref_value,
+                                          self.amin, self.top_db)
+
+    class MFCC(Layer):
+        def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                     n_fft: int = 512, n_mels: int = 64, **kwargs):
+            super().__init__()
+            self.logmel = features.LogMelSpectrogram(
+                sr=sr, n_fft=n_fft, n_mels=n_mels, **kwargs)
+            # DCT-II basis [n_mfcc, n_mels], orthonormal
+            n = np.arange(n_mels)
+            k = np.arange(n_mfcc)[:, None]
+            basis = np.cos(np.pi / n_mels * (n + 0.5) * k)
+            basis[0] *= 1.0 / math.sqrt(2)
+            basis *= math.sqrt(2.0 / n_mels)
+            self._dct = basis.astype(np.float32)
+
+        def forward(self, x):
+            lm = self.logmel(x)                  # [..., mels, frames]
+            dct = self._dct
+            return apply_op(
+                lambda s: __import__("jax.numpy", fromlist=["x"]).einsum(
+                    "cm,...mt->...ct", dct, s), lm)

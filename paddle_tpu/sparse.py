@@ -1,0 +1,175 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Reference parity: python/paddle/sparse (SURVEY.md §2.2 row) over phi
+sparse kernels.  TPU-native design: backed by
+``jax.experimental.sparse.BCOO`` — XLA's batched-COO format whose
+matmuls lower to gather/scatter+MXU kernels; the paddle surface
+(sparse_coo_tensor, to_dense, sparse.matmul/add/...) wraps SparseTensor
+around it.  CSR inputs are converted to COO (BCOO is the one
+TPU-lowerable format).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .common.errors import enforce
+from .tensor import Tensor, to_tensor
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "matmul", "add", "multiply", "to_dense", "is_sparse_coo",
+           "relu", "transpose", "masked_matmul"]
+
+
+class SparseCooTensor:
+    """Value wrapper over a BCOO array (paddle SparseCooTensor parity)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- paddle surface -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return to_tensor(np.asarray(self._bcoo.indices).T)   # [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return to_tensor(np.asarray(self._bcoo.data))
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]]
+                      = None, dtype=None, stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout); values: [nnz]."""
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    val = np.asarray(values.numpy() if isinstance(values, Tensor)
+                     else values)
+    enforce(idx.ndim == 2, "indices must be [ndim, nnz]")
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    if dtype is not None:
+        from .common.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    bcoo = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """CSR input converted to COO (BCOO is the TPU-lowerable format)."""
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor)
+                       else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape,
+                             dtype=dtype)
+
+
+def _unwrap(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, Tensor):
+        return x.value
+    import jax.numpy as jnp
+    return jnp.asarray(x)
+
+
+def is_sparse_coo(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def matmul(x, y):
+    """sparse @ dense (or sparse @ sparse -> dense result)."""
+    from jax.experimental import sparse as jsparse
+    a, b = _unwrap(x), _unwrap(y)
+    out = a @ b
+    if isinstance(out, jsparse.BCOO):
+        return SparseCooTensor(out)
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """Dense@dense evaluated ONLY at mask's nonzero positions (paddle
+    sparse.masked_matmul) — the sampled-dense-dense product."""
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+    a, b = _unwrap(x), _unwrap(y)
+    idx = mask._bcoo.indices                     # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask.shape))
+
+
+def add(x, y):
+    from jax.experimental import sparse as jsparse
+    a, b = _unwrap(x), _unwrap(y)
+    if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
+        import jax.numpy as jnp
+        data = jnp.concatenate([a.data, b.data])
+        idx = jnp.concatenate([a.indices, b.indices])
+        return SparseCooTensor(
+            jsparse.BCOO((data, idx), shape=a.shape).sum_duplicates(
+                nse=a.nse + b.nse))
+    out = (a.todense() if isinstance(a, jsparse.BCOO) else a) + \
+          (b.todense() if isinstance(b, jsparse.BCOO) else b)
+    return Tensor(out)
+
+
+def multiply(x, y):
+    """Elementwise; sparse*dense keeps sparsity."""
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+    if isinstance(x, SparseCooTensor) and not isinstance(
+            y, SparseCooTensor):
+        d = _unwrap(y)
+        idx = x._bcoo.indices
+        vals = x._bcoo.data * d[idx[:, 0], idx[:, 1]] if d.ndim == 2 \
+            else x._bcoo.data * d
+        return SparseCooTensor(jsparse.BCOO((vals, idx), shape=x.shape))
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return multiply(x, Tensor(y._bcoo.todense()))
+    return multiply(y, x)
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices), shape=x.shape))
+
+
+def transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
+    from jax.experimental import sparse as jsparse
+    import jax.numpy as jnp
+    idx = x._bcoo.indices[:, jnp.asarray(list(perm))]
+    shape = tuple(x.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx), shape=shape))

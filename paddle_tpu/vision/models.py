@@ -45,20 +45,24 @@ class VGG(Layer):
         super().__init__()
         self.features = features
         self.with_pool = with_pool
+        self.num_classes = num_classes
         if with_pool:
             self.avgpool = AdaptiveAvgPool2D((7, 7))
-        self.classifier = Sequential(
-            Linear(512 * 7 * 7, 4096), ReLU(), Dropout(),
-            Linear(4096, 4096), ReLU(), Dropout(),
-            Linear(4096, num_classes))
+        if num_classes > 0:        # <=0: backbone/feature-extractor mode
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(),
+                Linear(4096, 4096), ReLU(), Dropout(),
+                Linear(4096, num_classes))
 
     def forward(self, x):
         x = self.features(x)
         if self.with_pool:
             x = self.avgpool(x)
-        from .. import ops as P
-        x = P.flatten(x, 1)
-        return self.classifier(x)
+        if self.num_classes > 0:
+            from .. import ops as P
+            x = P.flatten(x, 1)
+            return self.classifier(x)
+        return x
 
 
 def _make_vgg_layers(cfg, batch_norm=False):
@@ -146,9 +150,11 @@ class ResNet(Layer):
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         self.with_pool = with_pool
+        self.num_classes = num_classes
         if with_pool:
             self.avgpool = AdaptiveAvgPool2D((1, 1))
-        self.fc = Linear(512 * block.expansion, num_classes)
+        if num_classes > 0:        # <=0: backbone/feature-extractor mode
+            self.fc = Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
         downsample = None
@@ -168,9 +174,11 @@ class ResNet(Layer):
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)
-        from .. import ops as P
-        x = P.flatten(x, 1)
-        return self.fc(x)
+        if self.num_classes > 0:
+            from .. import ops as P
+            x = P.flatten(x, 1)
+            return self.fc(x)
+        return x
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -67,6 +67,8 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
         arr = np.asarray(img.numpy())
     else:
         arr = _to_np(img).astype(np.float32)
+    if to_rgb:    # BGR input (cv2 convention): swap before normalizing
+        arr = arr[::-1] if data_format == "CHW" else arr[..., ::-1]
     mean = np.atleast_1d(np.asarray(mean, np.float32))
     std = np.atleast_1d(np.asarray(std, np.float32))
     c = arr.shape[0] if data_format == "CHW" else arr.shape[-1]
@@ -139,6 +141,15 @@ def center_crop(img, output_size):
     arr = _to_np(img)
     h, w = arr.shape[:2]
     th, tw = output_size
+    if th > h or tw > w:
+        # pad to the requested size (paddle's PIL backend behavior);
+        # silently returning an undersized image breaks batch collation
+        pt, pl = max(0, (th - h) // 2), max(0, (tw - w) // 2)
+        arr = np.pad(arr, ((pt, max(0, th - h) - pt),
+                           (pl, max(0, tw - w) - pl), (0, 0)))
+        was_pil = _is_pil(img)
+        img = _to_pil(arr) if was_pil else arr
+        h, w = arr.shape[:2]
     top = max(0, (h - th) // 2)
     left = max(0, (w - tw) // 2)
     return crop(img, top, left, th, tw)
@@ -233,7 +244,8 @@ class RandomCrop(BaseTransform):
             h, w = arr.shape[:2]
         top = random.randint(0, h - th)
         left = random.randint(0, w - tw)
-        return arr[top:top + th, left:left + tw]
+        out = arr[top:top + th, left:left + tw]
+        return _to_pil(out) if _is_pil(img) else out
 
 
 class RandomHorizontalFlip(BaseTransform):
@@ -275,9 +287,11 @@ class RandomResizedCrop(BaseTransform):
                 top = random.randint(0, h - ch)
                 left = random.randint(0, w - cw)
                 patch = arr[top:top + ch, left:left + cw]
-                return resize(patch, self.size, self.interpolation)
-        return resize(center_crop(arr, min(h, w)), self.size,
-                      self.interpolation)
+                out = resize(patch, self.size, self.interpolation)
+                return _to_pil(out) if _is_pil(img) else out
+        out = resize(center_crop(arr, min(h, w)), self.size,
+                     self.interpolation)
+        return _to_pil(out) if _is_pil(img) else out
 
 
 class Pad(BaseTransform):
@@ -307,15 +321,15 @@ class Grayscale(BaseTransform):
         self.n = num_output_channels
 
     def _apply_image(self, img):
-        arr = _to_np(img).astype(np.float32)
+        raw = _to_np(img)
+        arr = raw.astype(np.float32)
         if arr.shape[-1] >= 3:
             g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
                  + 0.114 * arr[..., 2])
         else:
             g = arr[..., 0]
         out = np.repeat(g[..., None], self.n, axis=-1)
-        return out.astype(np.uint8) if _to_np(img).dtype == np.uint8 \
-            else out
+        return out.astype(raw.dtype)
 
 
 class RandomRotation(BaseTransform):
@@ -334,15 +348,32 @@ class RandomRotation(BaseTransform):
         from PIL import Image
         modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
                  "bicubic": Image.BICUBIC}
-        pil = img if _is_pil(img) else _to_pil(_to_np(img).astype(np.uint8))
         angle = random.uniform(*self.degrees)
-        fill = self.fill
-        if isinstance(fill, numbers.Number) and pil.mode == "RGB":
-            fill = (int(fill),) * 3
-        out = pil.rotate(angle, resample=modes[self.interpolation],
+        if _is_pil(img):
+            fill = self.fill
+            if isinstance(fill, numbers.Number) and img.mode == "RGB":
+                fill = (int(fill),) * 3
+            return img.rotate(angle, resample=modes[self.interpolation],
+                              expand=self.expand, center=self.center,
+                              fillcolor=fill)
+        raw = _to_np(img)
+        if raw.dtype == np.uint8:
+            out = _to_pil(raw).rotate(
+                angle, resample=modes[self.interpolation],
+                expand=self.expand, center=self.center,
+                fillcolor=self.fill if raw.shape[-1] == 1
+                else (int(self.fill),) * raw.shape[-1]
+                if isinstance(self.fill, numbers.Number) else self.fill)
+            return _to_np(out)
+        # float data: per-channel 32-bit-float rotation (a uint8 cast
+        # would wrap negatives / truncate [0,1] data)
+        chans = [np.asarray(Image.fromarray(raw[:, :, c].astype(
+                     np.float32), mode="F")
+                 .rotate(angle, resample=modes[self.interpolation],
                          expand=self.expand, center=self.center,
-                         fillcolor=fill)
-        return out if _is_pil(img) else _to_np(out)
+                         fillcolor=float(self.fill)))
+                 for c in range(raw.shape[-1])]
+        return np.stack(chans, axis=-1).astype(raw.dtype)
 
 
 class BrightnessTransform(BaseTransform):

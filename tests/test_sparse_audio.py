@@ -1,0 +1,110 @@
+"""paddle.sparse (BCOO-backed) and paddle.audio (FFT features) —
+SURVEY.md §2.2 vision/metric/audio/sparse row."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, sparse
+
+
+def _coo():
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = np.array([2.0, -3.0, 4.0], np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, [3, 3])
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        s = _coo()
+        assert s.shape == (3, 3) and s.nnz == 3
+        dense = np.asarray(s.to_dense().numpy())
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 0], want[2, 2] = 2, -3, 4
+        np.testing.assert_array_equal(dense, want)
+        np.testing.assert_array_equal(np.asarray(s.indices().numpy()),
+                                      [[0, 1, 2], [1, 0, 2]])
+
+    def test_csr_constructor(self):
+        s = sparse.sparse_csr_tensor([0, 1, 2], [1, 0],
+                                     np.array([5.0, 6.0], np.float32),
+                                     [2, 2])
+        dense = np.asarray(s.to_dense().numpy())
+        np.testing.assert_array_equal(dense, [[0, 5], [6, 0]])
+
+    def test_matmul_vs_dense(self):
+        s = _coo()
+        rng = np.random.default_rng(0)
+        d = rng.normal(size=(3, 4)).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(d))
+        want = np.asarray(s.to_dense().numpy()) @ d
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-5)
+
+    def test_add_merges_duplicates(self):
+        a = _coo()
+        b = sparse.sparse_coo_tensor([[0], [1]],
+                                     np.array([10.0], np.float32), [3, 3])
+        out = sparse.add(a, b)
+        assert sparse.is_sparse_coo(out)
+        assert np.asarray(out.to_dense().numpy())[0, 1] == 12.0
+
+    def test_multiply_relu_transpose(self):
+        s = _coo()
+        m = sparse.multiply(s, paddle.to_tensor(
+            np.full((3, 3), 2.0, np.float32)))
+        assert np.asarray(m.to_dense().numpy())[2, 2] == 8.0
+        r = sparse.relu(s)
+        assert np.asarray(r.to_dense().numpy())[1, 0] == 0.0
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_array_equal(
+            np.asarray(t.to_dense().numpy()),
+            np.asarray(s.to_dense().numpy()).T)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(3, 5)).astype(np.float32)
+        b = rng.normal(size=(5, 3)).astype(np.float32)
+        mask = _coo()
+        out = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        full = a @ b
+        dense = np.asarray(out.to_dense().numpy())
+        np.testing.assert_allclose(dense[0, 1], full[0, 1], rtol=1e-5)
+        assert dense[0, 0] == 0.0          # not in mask
+
+
+class TestAudio:
+    def test_mel_scale_roundtrip(self):
+        f = np.array([100.0, 1000.0, 4000.0])
+        np.testing.assert_allclose(
+            audio.functional.mel_to_hz(audio.functional.hz_to_mel(f)), f,
+            rtol=1e-6)
+
+    def test_fbank_shape_and_partition(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fb.shape == (40, 257)
+        assert fb.min() >= 0
+
+    def test_spectrogram_identifies_tone(self):
+        sr, n_fft = 16000, 512
+        t = np.arange(sr, dtype=np.float32) / sr
+        freq = 1000.0
+        wave = np.sin(2 * np.pi * freq * t)[None]     # [1, T]
+        spec = audio.features.Spectrogram(n_fft=n_fft)(
+            paddle.to_tensor(wave))
+        s = np.asarray(spec.numpy())[0]               # [bins, frames]
+        peak_bin = s.mean(axis=1).argmax()
+        np.testing.assert_allclose(peak_bin * sr / n_fft, freq, atol=40)
+
+    def test_mel_and_mfcc_shapes(self):
+        wave = np.random.default_rng(0).normal(
+            size=(2, 16000)).astype(np.float32)
+        mel = audio.features.MelSpectrogram(
+            sr=16000, n_fft=512, n_mels=40)(paddle.to_tensor(wave))
+        assert np.asarray(mel.numpy()).shape[:2] == (2, 40)
+        logmel = audio.features.LogMelSpectrogram(
+            sr=16000, n_fft=512, n_mels=40)(paddle.to_tensor(wave))
+        assert np.isfinite(np.asarray(logmel.numpy())).all()
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512,
+                                   n_mels=40)(paddle.to_tensor(wave))
+        assert np.asarray(mfcc.numpy()).shape[:2] == (2, 13)

@@ -215,3 +215,47 @@ class TestTransformEdgeCases:
     def test_vision_exports(self):
         assert callable(paddle.vision.resnet101)
         assert paddle.vision.VGG is not None
+
+
+class TestReviewRegressions:
+    def test_rotation_preserves_float(self):
+        import random
+        random.seed(0)
+        img = np.random.default_rng(0).normal(
+            size=(8, 8, 3)).astype(np.float32)
+        out = T.RandomRotation(30)(img)
+        assert out.dtype == np.float32
+        assert out.min() < 0              # no uint8 wrap
+
+    def test_center_crop_pads_small_images(self):
+        img = np.ones((4, 4, 3), np.uint8) * 9
+        out = T.CenterCrop(6)(img)
+        assert out.shape[:2] == (6, 6)
+        assert out[0, 0, 0] == 0 and out[3, 3, 0] == 9
+
+    def test_random_crop_preserves_pil(self):
+        import random
+        random.seed(0)
+        from PIL import Image
+        pil = Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+        out = T.RandomCrop(4)(pil)
+        assert isinstance(out, Image.Image)
+
+    def test_feature_extractor_mode(self):
+        paddle.seed(0)
+        m = paddle.vision.resnet18(num_classes=-1)
+        m.eval()
+        x = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (1, 512, 1, 1)
+
+    def test_grayscale_preserves_dtype(self):
+        img = np.full((4, 4, 3), 0.5, np.float64)
+        out = T.Grayscale(1)(img)
+        assert out.dtype == np.float64
+
+    def test_normalize_to_rgb_swaps(self):
+        arr = np.zeros((3, 2, 2), np.float32)
+        arr[0] = 1.0                       # "B" channel
+        out = T.normalize(arr, [0.0], [1.0], to_rgb=True)
+        assert out[2].sum() == 4.0 and out[0].sum() == 0.0
