@@ -113,7 +113,7 @@ def _chunk_attn(q, k, v, causal: bool, q_off, k_off):
                                         causal and static_diag):
         if not causal or static_diag:
             from ..ops.pallas.flash_attention import _fwd, _pick_blocks
-            bq, bk = _pick_blocks(lq, lk)
+            bq, bk = _pick_blocks(lq, lk, d)
             o, lse = _fwd(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                           jnp.swapaxes(v, 1, 2),
                           causal=causal, bq=bq, bk=bk)
