@@ -46,10 +46,11 @@ from . import audio
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).
 
+from . import static
+from .static import disable_static, enable_static
+
 # paddle API aliases
 bool = bool_  # noqa: A001
-disable_static = lambda *a, **k: None  # dygraph is the default; API parity
-enable_static = lambda *a, **k: None
 
 CPUPlace = lambda: device.Place("cpu", 0)
 TPUPlace = lambda idx=0: device.Place("tpu", idx)

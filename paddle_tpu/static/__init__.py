@@ -1,0 +1,27 @@
+"""paddle.static — program-building (static graph) facade.
+
+Reference parity: python/paddle/static (SURVEY.md §2.2 static-mode row):
+``enable_static(); x = static.data(...); y = ops(x); exe = Executor();
+exe.run(feed=..., fetch_list=[y])``.
+
+TPU-native design: the reference's ProgramDesc/interpreter stack
+collapses into XLA — here a Program records each op call (the raw
+jax-level fn + its inputs) as ops execute symbolically on
+StaticVariable placeholders; ``Executor.run`` replays the recorded
+graph as ONE ``jax.jit`` program (compiled per feed-shape signature).
+Layer parameters touched while building are captured BY REFERENCE, so
+the executed program always sees their current values.  Training in
+static mode (append_backward/minimize) is not ported — the dygraph +
+``to_static`` path is this framework's compile story; the facade
+covers program building and inference-style execution.
+"""
+from .graph import (Executor, InputSpec, Program, StaticVariable, data,
+                    default_main_program, default_startup_program,
+                    program_guard, scope_guard, global_scope, name_scope,
+                    enable_static, disable_static, in_static_mode)
+
+__all__ = ["Program", "StaticVariable", "Executor", "data",
+           "program_guard", "default_main_program",
+           "default_startup_program", "scope_guard", "global_scope",
+           "name_scope", "InputSpec", "enable_static", "disable_static",
+           "in_static_mode"]

@@ -1,0 +1,356 @@
+"""Deferred op-recording graph behind paddle.static (see __init__)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.dtype import convert_dtype
+from ..common.errors import enforce
+from ..jit.to_static import InputSpec
+
+__all__ = ["Program", "StaticVariable", "Executor", "data",
+           "program_guard", "default_main_program",
+           "default_startup_program", "enable_static", "disable_static",
+           "in_static_mode", "scope_guard", "global_scope", "name_scope",
+           "InputSpec"]
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "main"):
+        _STATE.main = Program()
+        _STATE.startup = Program()
+        _STATE.static_mode = False
+    return _STATE
+
+
+class _OpNode:
+    __slots__ = ("raw_fn", "template", "inputs", "kwargs", "n_outputs",
+                 "_treedef")
+
+    def __init__(self, raw_fn, template, inputs, kwargs, n_outputs):
+        self.raw_fn = raw_fn
+        self.template = template      # apply_op template: ("t"/"tl"/"s")
+        self.inputs = inputs          # leaves: StaticVariable | Tensor |
+        self.kwargs = kwargs          #         ndarray constants
+        self.n_outputs = n_outputs
+
+
+class StaticVariable:
+    """Symbolic value inside a Program (paddle static Variable parity).
+    Shape metadata uses -1 for dynamic dims (the batch dim of
+    ``static.data``); execution uses the fed arrays' real shapes."""
+
+    __static_var__ = True      # apply_op's record-instead-of-execute marker
+
+    def __init__(self, program: "Program", shape, dtype,
+                 name: Optional[str] = None, producer: Optional[_OpNode]
+                 = None, out_idx: int = 0):
+        self.program = program
+        self.shape = tuple(int(s) if s is not None else -1 for s in shape)
+        self.dtype = dtype
+        self.name = name or f"tmp_{len(program.vars)}"
+        self.producer = producer
+        self.out_idx = out_idx
+        self.stop_gradient = True
+        program.vars[self.name] = self
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return (f"StaticVariable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    # ops surface: paddle.xxx(var) routes through apply_op already; the
+    # method/operator surface resolves from the same registry
+    def _op(self, name):
+        from ..ops import api as _api
+        fn = getattr(_api, name, None)
+        enforce(fn is not None, f"static Variable has no op {name!r}")
+        return fn
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops.api import TENSOR_METHODS
+        fn = TENSOR_METHODS.get(name)
+        if fn is None:
+            raise AttributeError(f"StaticVariable.{name}")
+        import functools
+        return functools.partial(fn, self)
+
+    def __add__(self, o):
+        return self._op("add")(self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._op("subtract")(self, o)
+
+    def __mul__(self, o):
+        return self._op("multiply")(self, o)
+
+    __rmul__ = __mul__
+
+    def __rsub__(self, o):
+        return self._op("subtract")(o, self)
+
+    def __truediv__(self, o):
+        return self._op("divide")(self, o)
+
+    def __rtruediv__(self, o):
+        return self._op("divide")(o, self)
+
+    def __pow__(self, o):
+        return self._op("pow")(self, o)
+
+    def __matmul__(self, o):
+        return self._op("matmul")(self, o)
+
+    def __rmatmul__(self, o):
+        return self._op("matmul")(o, self)
+
+    def __neg__(self):
+        return self._op("neg")(self)
+
+
+class Program:
+    """Recorded op list + variables (ProgramDesc parity)."""
+
+    def __init__(self):
+        self.ops: List[_OpNode] = []
+        self.vars: Dict[str, StaticVariable] = {}
+        self.feeds: List[str] = []
+        self._exec_cache: Dict[Any, Callable] = {}
+
+    def _record(self, raw_fn, template, leaves, kwargs):
+        """Called from apply_op when a StaticVariable is among inputs."""
+        import jax
+
+        node = _OpNode(raw_fn, template, list(leaves), dict(kwargs), 1)
+        self.ops.append(node)
+        self._exec_cache.clear()
+
+        # shape/dtype inference: eval_shape with -1 dims -> 1
+        def spec_of(x):
+            if isinstance(x, StaticVariable):
+                shape = tuple(1 if s == -1 else s for s in x.shape)
+                return jax.ShapeDtypeStruct(shape, convert_dtype(x.dtype))
+            from ..tensor import Tensor
+            v = x.value if isinstance(x, Tensor) else np.asarray(x)
+            return jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+
+        from ..tensor import rebuild_from_template
+
+        # build-time shape check: op errors surface HERE (paddle's
+        # program-build checks), not later inside Executor.run's jit
+        specs = [spec_of(x) for x in leaves]
+        shapes = jax.eval_shape(
+            lambda *a: raw_fn(*rebuild_from_template(template, a),
+                              **kwargs), *specs)
+        flat, treedef = jax.tree_util.tree_flatten(shapes)
+        node.n_outputs = len(flat)
+        node._treedef = treedef
+
+        # dynamic batch propagation: if any input var had a -1 leading
+        # dim and the output's leading dim matched the substituted 1,
+        # mark it dynamic again (heuristic, metadata only)
+        dyn_batch = any(isinstance(x, StaticVariable) and x.shape[:1]
+                        == (-1,) for x in leaves)
+        outs = []
+        for i, s in enumerate(flat):
+            shape = list(s.shape)
+            if dyn_batch and shape and shape[0] == 1:
+                shape[0] = -1
+            outs.append(StaticVariable(self, shape, str(s.dtype),
+                                       producer=node, out_idx=i))
+        tree = jax.tree_util.tree_unflatten(treedef, outs)
+        return tree
+
+    # -- execution ------------------------------------------------------------
+    def _captured_tensors(self):
+        """Layer parameters (and other live Tensors) referenced by the
+        recorded ops, in first-seen order.  They are passed to the jitted
+        replay as ARGUMENTS so in-place updates (optimizer steps,
+        set_value) are visible on the next run — baking them in as
+        constants would freeze the weights into the compiled program."""
+        from ..tensor import Tensor
+        order: Dict[int, int] = {}
+        tensors = []
+        for node in self.ops:
+            for x in node.inputs:
+                if isinstance(x, Tensor) and id(x) not in order:
+                    order[id(x)] = len(tensors)
+                    tensors.append(x)
+        return tensors, order
+
+    def _evaluate(self, feed: Dict[str, Any], param_vals, param_index):
+        """Topological replay (called under jax.jit by Executor)."""
+        from ..tensor import Tensor
+
+        values: Dict[Tuple[int, int], Any] = {}
+
+        def value_of(x):
+            if isinstance(x, StaticVariable):
+                if x.producer is None:
+                    enforce(x.name in feed,
+                            f"feed missing for '{x.name}'")
+                    return feed[x.name]
+                return values[(id(x.producer), x.out_idx)]
+            if isinstance(x, Tensor):
+                return param_vals[param_index[id(x)]]
+            return x
+
+        import jax
+
+        from ..tensor import rebuild_from_template
+        for node in self.ops:
+            args = rebuild_from_template(
+                node.template, [value_of(x) for x in node.inputs])
+            out = node.raw_fn(*args, **node.kwargs)
+            flat, _ = jax.tree_util.tree_flatten(out)
+            for i, o in enumerate(flat):
+                values[(id(node), i)] = o
+        return values
+
+    def to_string(self, throw_on_error=False):
+        lines = [f"Program: {len(self.ops)} ops, {len(self.vars)} vars"]
+        for n in self.ops:
+            ins = [x.name if isinstance(x, StaticVariable) else "<const>"
+                   for x in n.inputs]
+            lines.append(f"  {getattr(n.raw_fn, '__name__', '?')}"
+                         f"({', '.join(ins)})")
+        return "\n".join(lines)
+
+
+# -- mode + default programs -------------------------------------------------
+
+def enable_static(place=None):
+    _state().static_mode = True
+
+
+def disable_static(place=None):
+    _state().static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _state().static_mode
+
+
+def default_main_program() -> Program:
+    return _state().main
+
+
+def default_startup_program() -> Program:
+    return _state().startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    st = _state()
+    saved = (st.main, st.startup)
+    st.main = main_program
+    if startup_program is not None:
+        st.startup = startup_program
+    try:
+        yield
+    finally:
+        st.main, st.startup = saved
+
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level=0) -> StaticVariable:
+    """paddle.static.data — feed placeholder (leading -1/None = dynamic
+    batch)."""
+    prog = default_main_program()
+    var = StaticVariable(prog, shape, dtype, name=name)
+    prog.feeds.append(name)
+    return var
+
+
+# -- Executor -----------------------------------------------------------------
+
+class Executor:
+    """paddle.static.Executor over jax.jit (place arg accepted/ignored —
+    XLA owns placement)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Optional[Sequence] = None, return_numpy=True):
+        import jax
+
+        prog = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        enforce(fetch_list, "Executor.run needs fetch_list")
+        fetches = [prog.vars[f] if isinstance(f, str) else f
+                   for f in fetch_list]
+
+        feed_arrays = {k: np.asarray(v.numpy()) if hasattr(v, "numpy")
+                       else np.asarray(v) for k, v in feed.items()}
+        tensors, param_index = prog._captured_tensors()
+        sig = (len(prog.ops),
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_arrays.items())),
+               tuple(id(f) for f in fetches))
+        fn = prog._exec_cache.get(sig)
+        if fn is None:
+            def run_graph(feed_arrays, param_vals):
+                values = prog._evaluate(feed_arrays, param_vals,
+                                        param_index)
+
+                def fetch_val(f):
+                    enforce(f.producer is not None or f.name in
+                            feed_arrays,
+                            f"cannot fetch unfed placeholder {f.name!r}")
+                    if f.producer is None:
+                        return feed_arrays[f.name]
+                    return values[(id(f.producer), f.out_idx)]
+                return [fetch_val(f) for f in fetches]
+
+            fn = jax.jit(run_graph)
+            prog._exec_cache[sig] = fn
+        outs = fn(feed_arrays, [t.value for t in tensors])
+        if return_numpy:
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        from ..tensor import Tensor
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        ...
+
+
+# -- scopes (API parity; XLA owns memory, scopes are namespaces only) --------
+
+class _Scope:
+    def var(self, name):
+        return None
+
+    def find_var(self, name):
+        return None
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    yield

@@ -317,6 +317,22 @@ def _differentiable(x, arr) -> bool:
             and dtypes.is_floating_point(arr.dtype))
 
 
+def rebuild_from_template(template, arrs):
+    """Reassemble apply_op's (kind, value) template with fresh tensor
+    leaves — THE single definition; static-graph record/replay reuse it
+    so the template encoding cannot drift between eager and static."""
+    it = iter(arrs)
+    out = []
+    for kind, v in template:
+        if kind == "t":
+            out.append(next(it))
+        elif kind == "tl":
+            out.append([next(it) for _ in range(v)])
+        else:
+            out.append(v)
+    return out
+
+
 def apply_op(raw_fn, *args, **kwargs):
     """Execute a raw jax-level op on Tensor/array args.
 
@@ -325,18 +341,36 @@ def apply_op(raw_fn, *args, **kwargs):
     tensor input requires grad and grad mode is on, runs through
     ``jax.vjp`` and records a GradNode.
     """
+    def _is_static(x):
+        # type-level lookup: instance __getattr__ must not run per leaf
+        return getattr(type(x), "__static_var__", False)
+
     template: List[Tuple[str, Any]] = []
     leaves: List[Any] = []
+    static_leaf = None
     for a in args:
         if _is_arraylike(a):
             template.append(("t", None))
             leaves.append(a)
+        elif _is_static(a):
+            template.append(("t", None))
+            leaves.append(a)
+            static_leaf = a
         elif isinstance(a, (list, tuple)) and len(a) > 0 and all(
-                _is_arraylike(x) for x in a):
+                _is_arraylike(x) or _is_static(x) for x in a):
             template.append(("tl", len(a)))
             leaves.extend(a)
+            for x in a:
+                if _is_static(x):
+                    static_leaf = x
         else:
             template.append(("s", a))
+
+    # static-graph mode: a StaticVariable input means this op is being
+    # RECORDED into its Program (paddle.static), not executed
+    if static_leaf is not None:
+        return static_leaf.program._record(raw_fn, template, leaves,
+                                           kwargs)
 
     arrays = [x.value if isinstance(x, Tensor) else jnp.asarray(x)
               for x in leaves]
@@ -355,16 +389,8 @@ def apply_op(raw_fn, *args, **kwargs):
                       for a in arrays]
 
     def rebuild(arrs):
-        it = iter(arrs)
-        out = []
-        for kind, v in template:
-            if kind == "t":
-                out.append(next(it))
-            elif kind == "tl":
-                out.append([next(it) for _ in range(v)])
-            else:
-                out.append(v)
-        return out
+        return rebuild_from_template(template, arrs)
+
 
     diff_idx = [i for i, x in enumerate(leaves)
                 if tape.is_grad_enabled() and _differentiable(x, arrays[i])]

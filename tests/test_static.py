@@ -1,0 +1,128 @@
+"""paddle.static program-building facade (SURVEY.md §2.2 static-mode
+row): ops record into a Program, Executor replays under one jit."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def test_build_and_run_basic():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.eye(4, dtype=np.float32) * 3)
+        y = paddle.relu(paddle.matmul(x, w) - 1.0)
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((2, 4), 2.0))
+
+
+def test_dynamic_batch_retraces():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3], "float32")
+        y = x * 2.0
+    exe = static.Executor()
+    for b in (1, 5):
+        out, = exe.run(prog, feed={"x": np.ones((b, 3), np.float32)},
+                       fetch_list=[y])
+        assert out.shape == (b, 3)
+        np.testing.assert_allclose(out, 2.0)
+
+
+def test_operators_and_methods_on_variables():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2], "float32")
+        y = (-x + 1.0) / 2.0
+        z = y.reshape([4])
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": np.full((2, 2), 3.0, np.float32)},
+                   fetch_list=[z])
+    np.testing.assert_allclose(out, np.full(4, -1.0))
+    assert z.shape == (4,)
+
+
+def test_layer_params_captured_by_reference():
+    """A Layer used while building keeps a live reference: updating the
+    parameter changes what the program computes (mirrors the reference's
+    scope-variable lookup at run time)."""
+    paddle.seed(0)
+    lin = nn.Linear(3, 2)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3], "float32")
+        y = lin(x)
+    exe = static.Executor()
+    xin = np.ones((1, 3), np.float32)
+    out1, = exe.run(prog, feed={"x": xin}, fetch_list=[y])
+    lin.weight.set_value(np.zeros((3, 2), np.float32))
+    lin.bias.set_value(np.full((2,), 7.0, np.float32))
+    out2, = exe.run(prog, feed={"x": xin}, fetch_list=[y])
+    np.testing.assert_allclose(out2, 7.0)
+    assert not np.allclose(out1, out2)
+
+
+def test_fetch_by_name_and_to_string():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2], "float32")
+        y = paddle.exp(x)
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": np.zeros(2, np.float32)},
+                   fetch_list=[y.name])
+    np.testing.assert_allclose(out, 1.0)
+    s = prog.to_string()
+    assert "exp" in s and "2 vars" in s
+
+
+def test_default_program_and_enable_static():
+    static.enable_static()
+    try:
+        assert static.in_static_mode()
+        main = static.default_main_program()
+        assert isinstance(main, static.Program)
+    finally:
+        static.disable_static()
+    assert not static.in_static_mode()
+
+
+def test_multi_output_op_in_graph():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [5], "float32")
+        vals, idx = paddle.topk(x, k=2)
+    exe = static.Executor()
+    v, i = exe.run(
+        prog, feed={"x": np.array([1, 9, 3, 7, 5], np.float32)},
+        fetch_list=[vals, idx])
+    np.testing.assert_allclose(v, [9, 7])
+    np.testing.assert_array_equal(i, [1, 3])
+
+
+def test_reflected_operators():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2], "float32")
+        y = 1.0 - x
+        z = 2.0 / (x + 1.0)
+    exe = static.Executor()
+    a, b = exe.run(prog, feed={"x": np.ones(2, np.float32)},
+                   fetch_list=[y, z])
+    np.testing.assert_allclose(a, 0.0)
+    np.testing.assert_allclose(b, 1.0)
+
+
+def test_build_time_shape_errors_surface():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 3], "float32")
+        w = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        with pytest.raises(Exception):
+            paddle.matmul(x, w)          # 3 vs 4: fails at BUILD time
+
+
+def test_disable_static_accepts_place():
+    paddle.disable_static(None)          # paddle signature parity
