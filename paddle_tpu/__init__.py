@@ -42,6 +42,8 @@ from . import inference
 from . import vision
 from . import sparse
 from . import audio
+from . import fft
+from . import distribution
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).

@@ -336,16 +336,8 @@ def _grad_create_graph(outputs, inputs, grad_outputs=None,
             primals = flat_args[_n_out:]
 
             def rebuild(arrs):
-                it = iter(arrs)
-                out = []
-                for kind, v in _template:
-                    if kind == "t":
-                        out.append(next(it))
-                    elif kind == "tl":
-                        out.append([next(it) for _ in range(v)])
-                    else:
-                        out.append(v)
-                return out
+                from ..tensor import rebuild_from_template
+                return rebuild_from_template(template, arrs)
 
             def f(*diff_arrays):
                 full = list(_arrays)

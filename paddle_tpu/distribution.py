@@ -1,0 +1,327 @@
+"""paddle.distribution — probability distributions.
+
+Reference parity: python/paddle/distribution (Distribution base,
+Normal/Uniform/Categorical/Bernoulli/..., kl_divergence registry).
+TPU-native: densities are jnp expressions on the tape (differentiable
+through log_prob — the RL/VAE use cases), sampling uses the framework
+RNG stream so ``paddle.seed`` governs reproducibility.
+"""
+from __future__ import annotations
+
+import math
+import numpy as np
+
+from .common.errors import enforce
+from .tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "Bernoulli", "Exponential", "Gumbel", "Laplace", "LogNormal",
+           "kl_divergence", "register_kl"]
+
+
+def _key():
+    from .ops.random import split_key
+    return split_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from . import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = to_tensor(loc, dtype="float32") \
+            if not isinstance(loc, Tensor) else loc
+        self.scale = to_tensor(scale, dtype="float32") \
+            if not isinstance(scale, Tensor) else scale
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(loc, scale):
+            return loc + scale * jax.random.normal(key, shp)
+        return apply_op(raw, self.loc, self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def raw(v, loc, scale):
+            import jax.numpy as jnp
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply_op(raw, value, self.loc, self.scale)
+
+    def entropy(self):
+        def raw(scale):
+            import jax.numpy as jnp
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return apply_op(raw, self.scale)
+
+    def kl_divergence(self, other: "Normal"):
+        def raw(l1, s1, l2, s2):
+            import jax.numpy as jnp
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+        return apply_op(raw, self.loc, self.scale, other.loc, other.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = to_tensor(low, dtype="float32") \
+            if not isinstance(low, Tensor) else low
+        self.high = to_tensor(high, dtype="float32") \
+            if not isinstance(high, Tensor) else high
+        super().__init__(tuple(np.broadcast_shapes(self.low.shape,
+                                                   self.high.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(low, high):
+            return jax.random.uniform(key, shp, minval=low, maxval=high)
+        return apply_op(raw, self.low, self.high)
+
+    def log_prob(self, value):
+        def raw(v, low, high):
+            import jax.numpy as jnp
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+        return apply_op(raw, value, self.low, self.high)
+
+    def entropy(self):
+        def raw(low, high):
+            import jax.numpy as jnp
+            return jnp.log(high - low)
+        return apply_op(raw, self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) \
+            else to_tensor(logits, dtype="float32")
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(logits):
+            return jax.random.categorical(key, logits, shape=shp)
+        return apply_op(raw, self.logits)
+
+    def log_prob(self, value):
+        def raw(logits, v):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            v = v.astype(jnp.int32)
+            if logp.ndim == 1:       # scalar batch: broadcast over value
+                logp = jnp.broadcast_to(
+                    logp, tuple(v.shape) + logp.shape[-1:])
+            return jnp.take_along_axis(logp, v[..., None],
+                                       axis=-1)[..., 0]
+        return apply_op(raw, self.logits, value)
+
+    def probs(self):
+        def raw(logits):
+            import jax
+            return jax.nn.softmax(logits, axis=-1)
+        return apply_op(raw, self.logits)
+
+    def entropy(self):
+        def raw(logits):
+            import jax
+            import jax.numpy as jnp
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return apply_op(raw, self.logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = probs if isinstance(probs, Tensor) \
+            else to_tensor(probs, dtype="float32")
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(p):
+            return jax.random.bernoulli(key, p, shape=shp).astype(
+                p.dtype)
+        return apply_op(raw, self.probs_)
+
+    def log_prob(self, value):
+        def raw(p, v):
+            import jax.numpy as jnp
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op(raw, self.probs_, value)
+
+    def entropy(self):
+        def raw(p):
+            import jax.numpy as jnp
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_op(raw, self.probs_)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = rate if isinstance(rate, Tensor) \
+            else to_tensor(rate, dtype="float32")
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(rate):
+            return jax.random.exponential(key, shp) / rate
+        return apply_op(raw, self.rate)
+
+    def log_prob(self, value):
+        def raw(rate, v):
+            import jax.numpy as jnp
+            return jnp.log(rate) - rate * v
+        return apply_op(raw, self.rate, value)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = to_tensor(loc, dtype="float32") \
+            if not isinstance(loc, Tensor) else loc
+        self.scale = to_tensor(scale, dtype="float32") \
+            if not isinstance(scale, Tensor) else scale
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(loc, scale):
+            return loc + scale * jax.random.gumbel(key, shp)
+        return apply_op(raw, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def raw(v, loc, scale):
+            import jax.numpy as jnp
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return apply_op(raw, value, self.loc, self.scale)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = to_tensor(loc, dtype="float32") \
+            if not isinstance(loc, Tensor) else loc
+        self.scale = to_tensor(scale, dtype="float32") \
+            if not isinstance(scale, Tensor) else scale
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(loc, scale):
+            return loc + scale * jax.random.laplace(key, shp)
+        return apply_op(raw, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def raw(v, loc, scale):
+            import jax.numpy as jnp
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+        return apply_op(raw, value, self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def sample(self, shape=()):
+        from . import ops
+        return ops.exp(self._normal.sample(shape))
+
+    def log_prob(self, value):
+        from . import ops
+        logv = ops.log(value)
+        return self._normal.log_prob(logv) - logv
+
+
+# -- KL registry --------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    own = getattr(p, "kl_divergence", None)
+    enforce(own is not None and isinstance(q, type(p)),
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return own(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat(p: Categorical, q: Categorical):
+    def raw(lp, lq):
+        import jax
+        import jax.numpy as jnp
+        a = jax.nn.log_softmax(lp, axis=-1)
+        b = jax.nn.log_softmax(lq, axis=-1)
+        return jnp.sum(jnp.exp(a) * (a - b), axis=-1)
+    return apply_op(raw, p.logits, q.logits)

@@ -1,0 +1,95 @@
+"""paddle.fft — discrete Fourier transform family.
+
+Reference parity: python/paddle/fft.py (phi fft kernels).  TPU-native:
+jnp.fft lowers to the XLA FFT HLO (TPU has a dedicated FFT
+implementation); norm-mode semantics follow paddle/numpy ("backward" |
+"ortho" | "forward").
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import apply_op
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift",
+           "ifftshift"]
+
+
+def _named(jfn, fn):
+    # raw_fn.__name__ keys AMP lists, nan-check reports, and static
+    # Program.to_string — an anonymous lambda defeats all three
+    fn.__name__ = jfn.__name__
+    return fn
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(
+            _named(jfn, lambda a: jfn(a, n=n, axis=axis, norm=norm)), x)
+    op.__name__ = jfn.__name__
+    return op
+
+
+def _wrapn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(
+            _named(jfn, lambda a: jfn(a, s=s, axes=axes, norm=norm)), x)
+    op.__name__ = jfn.__name__
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(_named(jnp.fft.fft2,
+        lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm)), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(_named(jnp.fft.ifft2,
+        lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm)), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(_named(jnp.fft.rfft2,
+        lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm)), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(_named(jnp.fft.irfft2,
+        lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm)), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out if dtype is None else out.astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .tensor import Tensor
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out if dtype is None else out.astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(_named(jnp.fft.fftshift,
+        lambda a: jnp.fft.fftshift(a, axes=axes)), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(_named(jnp.fft.ifftshift,
+        lambda a: jnp.fft.ifftshift(a, axes=axes)), x)

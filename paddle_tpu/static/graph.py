@@ -119,6 +119,28 @@ class StaticVariable:
     def __neg__(self):
         return self._op("neg")(self)
 
+    # comparisons must RECORD elementwise ops — default __eq__ would
+    # silently evaluate to a Python bool and corrupt the program
+    def __eq__(self, o):
+        return self._op("equal")(self, o)
+
+    def __ne__(self, o):
+        return self._op("not_equal")(self, o)
+
+    def __lt__(self, o):
+        return self._op("less_than")(self, o)
+
+    def __le__(self, o):
+        return self._op("less_equal")(self, o)
+
+    def __gt__(self, o):
+        return self._op("greater_than")(self, o)
+
+    def __ge__(self, o):
+        return self._op("greater_equal")(self, o)
+
+    __hash__ = object.__hash__      # __eq__ override must not unhash
+
 
 class Program:
     """Recorded op list + variables (ProgramDesc parity)."""

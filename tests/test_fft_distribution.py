@@ -1,0 +1,124 @@
+"""paddle.fft (XLA FFT HLO) and paddle.distribution (differentiable
+densities) — remaining paddle API families."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D, fft
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16,)).astype(np.float32)
+        y = fft.fft(_t(x))
+        back = fft.ifft(y)
+        np.testing.assert_allclose(np.asarray(back.numpy()).real, x,
+                                   atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(3, 32)).astype(np.float32)
+        got = np.asarray(fft.rfft(_t(x)).numpy())
+        np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        got = np.asarray(fft.fft2(_t(x)).numpy())
+        np.testing.assert_allclose(got, np.fft.fft2(x), rtol=1e-4,
+                                   atol=1e-4)
+        sh = np.asarray(fft.fftshift(_t(x)).numpy())
+        np.testing.assert_allclose(sh, np.fft.fftshift(x))
+
+    def test_fftfreq_ortho_norm(self):
+        np.testing.assert_allclose(np.asarray(fft.fftfreq(8).numpy()),
+                                   np.fft.fftfreq(8))
+        x = np.ones(4, np.float32)
+        got = np.asarray(fft.fft(_t(x), norm="ortho").numpy())
+        np.testing.assert_allclose(got, np.fft.fft(x, norm="ortho"),
+                                   atol=1e-6)
+
+
+class TestDistribution:
+    def test_normal_log_prob_and_entropy(self):
+        d = D.Normal(0.0, 2.0)
+        lp = float(np.asarray(d.log_prob(_t(1.0)).numpy()))
+        from scipy import stats
+        np.testing.assert_allclose(lp, stats.norm(0, 2).logpdf(1.0),
+                                   rtol=1e-5)
+        ent = float(np.asarray(d.entropy().numpy()))
+        np.testing.assert_allclose(ent, stats.norm(0, 2).entropy(),
+                                   rtol=1e-5)
+
+    def test_normal_sampling_moments(self):
+        paddle.seed(0)
+        d = D.Normal(3.0, 0.5)
+        s = np.asarray(d.sample([20000]).numpy())
+        np.testing.assert_allclose(s.mean(), 3.0, atol=0.05)
+        np.testing.assert_allclose(s.std(), 0.5, atol=0.05)
+
+    def test_normal_kl(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        got = float(np.asarray(D.kl_divergence(p, q).numpy()))
+        want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_categorical(self):
+        paddle.seed(0)
+        logits = np.log(np.array([0.7, 0.2, 0.1], np.float32))
+        d = D.Categorical(logits)
+        s = np.asarray(d.sample([5000]).numpy())
+        freq = np.bincount(s, minlength=3) / 5000
+        np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.03)
+        lp = np.asarray(d.log_prob(_t(np.array([0]))).numpy())
+        np.testing.assert_allclose(lp, np.log(0.7), rtol=1e-4)
+        kl = float(np.asarray(
+            D.kl_divergence(d, D.Categorical(logits)).numpy()))
+        np.testing.assert_allclose(kl, 0.0, atol=1e-6)
+
+    def test_bernoulli_uniform_exponential(self):
+        from scipy import stats
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            float(np.asarray(b.log_prob(_t(1.0)).numpy())), np.log(0.3),
+            rtol=1e-4)
+        u = D.Uniform(0.0, 4.0)
+        np.testing.assert_allclose(
+            float(np.asarray(u.log_prob(_t(1.0)).numpy())), -np.log(4.0),
+            rtol=1e-5)
+        assert np.isneginf(float(np.asarray(u.log_prob(_t(5.0)).numpy())))
+        e = D.Exponential(2.0)
+        np.testing.assert_allclose(
+            float(np.asarray(e.log_prob(_t(1.0)).numpy())),
+            stats.expon(scale=0.5).logpdf(1.0), rtol=1e-5)
+
+    def test_log_prob_differentiable(self):
+        """REINFORCE-style gradient through log_prob."""
+        loc = paddle.to_tensor(np.float32(0.5))
+        loc.stop_gradient = False
+        d = D.Normal(loc, 1.0)
+        lp = d.log_prob(_t(2.0))
+        lp.backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.5,
+                                   rtol=1e-5)    # d/dloc = (v-loc)/var
+
+    def test_gumbel_laplace_lognormal(self):
+        from scipy import stats
+        g = D.Gumbel(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(np.asarray(g.log_prob(_t(0.3)).numpy())),
+            stats.gumbel_r().logpdf(0.3), rtol=1e-5)
+        l = D.Laplace(0.0, 2.0)
+        np.testing.assert_allclose(
+            float(np.asarray(l.log_prob(_t(1.0)).numpy())),
+            stats.laplace(scale=2.0).logpdf(1.0), rtol=1e-5)
+        ln = D.LogNormal(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(np.asarray(ln.log_prob(_t(2.0)).numpy())),
+            stats.lognorm(1.0).logpdf(2.0), rtol=1e-5)

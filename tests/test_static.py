@@ -126,3 +126,18 @@ def test_build_time_shape_errors_surface():
 
 def test_disable_static_accepts_place():
     paddle.disable_static(None)          # paddle signature parity
+
+
+def test_comparisons_record_ops():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3], "float32")
+        m = x == 1.0
+        n = x > 0.5
+    assert not isinstance(m, bool)       # recorded, not evaluated
+    exe = static.Executor()
+    a, b = exe.run(prog,
+                   feed={"x": np.array([0.0, 1.0, 2.0], np.float32)},
+                   fetch_list=[m, n])
+    np.testing.assert_array_equal(a, [False, True, False])
+    np.testing.assert_array_equal(b, [False, True, True])
