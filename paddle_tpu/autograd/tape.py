@@ -336,8 +336,9 @@ def _grad_create_graph(outputs, inputs, grad_outputs=None,
             primals = flat_args[_n_out:]
 
             def rebuild(arrs):
+                # _template (default-arg bound), NOT the loop variable
                 from ..tensor import rebuild_from_template
-                return rebuild_from_template(template, arrs)
+                return rebuild_from_template(_template, arrs)
 
             def f(*diff_arrays):
                 full = list(_arrays)

@@ -32,8 +32,8 @@ def _wrap1(jfn):
     return op
 
 
-def _wrapn(jfn):
-    def op(x, s=None, axes=None, norm="backward", name=None):
+def _wrapn(jfn, default_axes=None):
+    def op(x, s=None, axes=default_axes, norm="backward", name=None):
         return apply_op(
             _named(jfn, lambda a: jfn(a, s=s, axes=axes, norm=norm)), x)
     op.__name__ = jfn.__name__
@@ -53,24 +53,10 @@ rfftn = _wrapn(jnp.fft.rfftn)
 irfftn = _wrapn(jnp.fft.irfftn)
 
 
-def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(_named(jnp.fft.fft2,
-        lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm)), x)
-
-
-def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(_named(jnp.fft.ifft2,
-        lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm)), x)
-
-
-def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(_named(jnp.fft.rfft2,
-        lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm)), x)
-
-
-def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply_op(_named(jnp.fft.irfft2,
-        lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm)), x)
+fft2 = _wrapn(jnp.fft.fft2, default_axes=(-2, -1))
+ifft2 = _wrapn(jnp.fft.ifft2, default_axes=(-2, -1))
+rfft2 = _wrapn(jnp.fft.rfft2, default_axes=(-2, -1))
+irfft2 = _wrapn(jnp.fft.irfft2, default_axes=(-2, -1))
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -2,10 +2,13 @@ from . import dit
 from . import ernie
 from . import gpt
 from . import llama
+from . import bert
 from . import qwen2_moe
 from .dit import AutoencoderKL, DiT, DiTConfig, DiTWithDiffusion
 from .ernie import Ernie45Config, Ernie45ForCausalLM, Ernie45ForCausalLMPipe
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe,
                     LlamaModel, LlamaPretrainingCriterion)
+from .bert import (BertConfig, BertForMaskedLM,
+                   BertForSequenceClassification, BertModel)
 from .qwen2_moe import Qwen2MoeConfig, Qwen2MoeForCausalLM

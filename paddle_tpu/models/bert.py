@@ -1,0 +1,168 @@
+"""BERT family (PaddleNLP bert parity: BertModel + task heads).
+
+Reference parity: PaddleNLP paddlenlp/transformers/bert — encoder-side
+coverage beyond the five BASELINE configs (the reference ecosystem's
+most-used encoder).  TPU-native: rides the shared nn.TransformerEncoder
+stack, whose attention routes through the fused flash path when
+eligible; padding masks arrive as additive biases the Pallas kernel
+consumes directly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import ops as P
+from ..nn import functional as F
+from ..nn.common import Dropout, Embedding, Linear
+from ..nn.initializer import Normal
+from ..nn.layer import Layer
+from ..nn.norm import LayerNorm
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..tensor import Tensor
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForMaskedLM", "bert_tiny_config"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+
+def bert_tiny_config() -> BertConfig:
+    return BertConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=64,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = Normal(0.0, c.initializer_range)
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(c.hidden_size,
+                                    epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = P.arange(s, dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = P.zeros([b, s], dtype="int32")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size,
+                            weight_attr=Normal(0.0, c.initializer_range))
+
+    def forward(self, x):
+        return P.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob,
+            act_dropout=c.hidden_dropout_prob,
+            layer_norm_eps=c.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, c.num_hidden_layers)
+        self.pooler = BertPooler(c)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        """attention_mask: [B, S] with 1 = attend (paddle/HF bert
+        convention); converted to the additive bias the fused attention
+        path consumes."""
+        if attention_mask is not None:
+            from ..tensor import to_tensor
+            m = attention_mask if isinstance(attention_mask, Tensor) \
+                else to_tensor(attention_mask)
+            bias = P.scale(P.cast(m, "float32") - 1.0, 1e30)  # 0 / -1e30
+            bias = P.unsqueeze(P.unsqueeze(bias, 1), 1)        # [B,1,1,S]
+        else:
+            bias = None
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = self.encoder(x, src_mask=bias)
+        return x, self.pooler(x)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes,
+                                 weight_attr=Normal(
+                                     0.0, config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class BertForMaskedLM(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        c = config
+        self.bert = BertModel(c)
+        self.transform = Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=Normal(0.0,
+                                                   c.initializer_range))
+        self.layer_norm = LayerNorm(c.hidden_size,
+                                    epsilon=c.layer_norm_eps)
+        # decoder tied to the word embeddings (bert convention)
+        self.decoder_bias = self.create_parameter(
+            [c.vocab_size], default_initializer=Normal(0.0, 0.0))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None, ignore_index=-100):
+        seq, _ = self.bert(input_ids, token_type_ids, position_ids,
+                           attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        logits = P.matmul(h, self.bert.embeddings.word_embeddings.weight,
+                          transpose_y=True) + self.decoder_bias
+        if labels is not None:
+            return F.cross_entropy(
+                P.reshape(logits, [-1, logits.shape[-1]]),
+                P.reshape(labels, [-1]), ignore_index=ignore_index)
+        return logits

@@ -141,6 +141,14 @@ class StaticVariable:
 
     __hash__ = object.__hash__      # __eq__ override must not unhash
 
+    def __bool__(self):
+        # truthiness of a symbolic variable is meaningless and, with a
+        # recording __eq__, would silently inject ghost ops through
+        # `var in list` / `if a == b:` — fail loudly (paddle parity)
+        raise TypeError(
+            "StaticVariable cannot be used as a python bool inside a "
+            "static program; use paddle.where / logical ops instead")
+
 
 class Program:
     """Recorded op list + variables (ProgramDesc parity)."""

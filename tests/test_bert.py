@@ -1,0 +1,83 @@
+"""BERT family e2e (encoder-side coverage beyond the five BASELINE
+configs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models.bert import (BertForMaskedLM,
+                                    BertForSequenceClassification,
+                                    BertModel, bert_tiny_config)
+
+
+def _batch(cfg, b=4, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, cfg.vocab_size, size=(b, s), dtype=np.int64)
+    return ids
+
+
+def test_bert_model_shapes():
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = _batch(cfg)
+    seq, pooled = m(paddle.to_tensor(ids))
+    assert tuple(seq.shape) == (4, 16, cfg.hidden_size)
+    assert tuple(pooled.shape) == (4, cfg.hidden_size)
+
+
+def test_attention_mask_excludes_padding():
+    """A padded position must not change unpadded positions' outputs."""
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    m = BertModel(cfg)
+    m.eval()
+    ids = _batch(cfg, b=1, s=8)
+    mask = np.ones((1, 8), np.int64)
+    mask[0, 6:] = 0
+    seq_a, _ = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(
+        mask))
+    ids_b = ids.copy()
+    ids_b[0, 6:] = 7            # change PADDED tokens only
+    seq_b, _ = m(paddle.to_tensor(ids_b), attention_mask=paddle.to_tensor(
+        mask))
+    np.testing.assert_allclose(np.asarray(seq_a.numpy())[0, :6],
+                               np.asarray(seq_b.numpy())[0, :6],
+                               atol=1e-5)
+
+
+def test_sequence_classification_trains():
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    m = BertForSequenceClassification(cfg, num_classes=3)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=m.parameters())
+    from paddle_tpu.jit.train import CompiledTrainStep
+    step = CompiledTrainStep(
+        m, lambda mm, b: mm(b["ids"], labels=b["y"]), opt)
+    rng = np.random.default_rng(0)
+    ids = _batch(cfg, b=8)
+    y = rng.integers(0, 3, size=(8,))
+    losses = [float(np.asarray(step({"ids": ids, "y": y})))
+              for _ in range(6)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_masked_lm_trains_and_ties_embeddings():
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    m = BertForMaskedLM(cfg)
+    logits = m(paddle.to_tensor(_batch(cfg)))
+    assert tuple(logits.shape) == (4, 16, cfg.vocab_size)
+
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    from paddle_tpu.jit.train import CompiledTrainStep
+    ids = _batch(cfg, b=8)
+    labels = np.where(np.random.default_rng(1).uniform(size=ids.shape)
+                      < 0.15, ids, -100)
+    step = CompiledTrainStep(
+        m, lambda mm, b: mm(b["ids"], labels=b["y"]), opt)
+    losses = [float(np.asarray(step({"ids": ids, "y": labels})))
+              for _ in range(6)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
