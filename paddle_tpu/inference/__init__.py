@@ -1,5 +1,6 @@
 from .predictor import Config, PredictorTensor, Predictor, create_predictor
 from .paged_cache import PagedKVCache
+from .engine import GenRequest, LLMEngine
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
-           "PagedKVCache"]
+           "PagedKVCache", "LLMEngine", "GenRequest"]

@@ -1,0 +1,255 @@
+"""LLMEngine — continuous-batching serving loop over the paged KV cache.
+
+Reference parity: the reference's serving story (AnalysisPredictor +
+PaddleNLP's llm serving loops); kernel blueprint per PAPERS.md ragged
+paged attention.  TPU-native design: requests of ragged lengths share
+one physical page pool; each engine step decodes ONE token for every
+active request as a single jitted program — a lax.scan over the stacked
+decoder layers whose attention is the Pallas ragged-paged kernel and
+whose K/V append is a vectorized page scatter.  Host-side work per step
+is only page-table bookkeeping (allocate/extend/release).  Admission
+(add_request) prefills through the model's standard cache path and
+bulk-writes the prompt K/V into the request's pages.
+
+The dense jitted ``generate()`` remains the single-tenant fast path;
+this engine is the multi-tenant path where requests join and leave
+between steps (continuous batching).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common.errors import enforce
+from .paged_cache import PagedKVCache
+
+__all__ = ["LLMEngine", "GenRequest"]
+
+
+class GenRequest:
+    def __init__(self, rid, prompt_ids, max_new_tokens, eos_token_id):
+        self.rid = rid
+        self.prompt = list(prompt_ids)
+        self.max_new = max_new_tokens
+        self.eos = eos_token_id
+        self.out: List[int] = []
+        self.slot: Optional[int] = None
+        self.done = False
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head"),
+    donate_argnames=("k_pages", "v_pages"))
+def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
+                       k_pages, v_pages, tokens, positions, tables, lens,
+                       *, eps: float, kvh: int, head_dim: int,
+                       transpose_head: bool = False):
+    """One decode token for every active sequence.
+
+    stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
+    order); k/v_pages [L, KVH, n_pages, P, D]; tokens [B] int32;
+    positions [B] (= current lengths); tables [B, maxp]; lens [B].
+    Returns (next_tokens [B], k_pages', v_pages').
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+    from ..ops.pallas.paged_attention import (paged_attention_raw,
+                                              paged_attention_reference,
+                                              paged_write)
+    from ..runtime.device import is_compiled_with_tpu
+
+    cos_t, sin_t = rope                       # [maxpos, D]
+    b = tokens.shape[0]
+    h = embed_w.shape[1]
+    x = jnp.take(embed_w, tokens, axis=0)     # [B, H]
+
+    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [B, 1, D]
+    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
+
+    from ..models.llama import _rotate_half as rotate_half
+
+    attend = paged_attention_raw if is_compiled_with_tpu() \
+        else paged_attention_reference
+
+    def layer(carry, xs):
+        hcur = carry
+        lp, kp, vp = xs                        # per-layer params + pools
+        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
+        nh = qw.shape[1] // head_dim
+        q = jnp.matmul(hn, qw).reshape(b, nh, head_dim)
+        k = jnp.matmul(hn, kw).reshape(b, kvh, head_dim)
+        v = jnp.matmul(hn, vw).reshape(b, kvh, head_dim)
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
+        k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
+        kp, vp = paged_write(kp, vp, k, v, tables, lens)
+        attn = attend(q, kp, vp, tables, lens + 1)     # incl. new token
+        hcur = hcur + jnp.matmul(attn.reshape(b, nh * head_dim), ow)
+        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
+        ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
+        return hcur + jnp.matmul(ff, dw), (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer, x, (tuple(stack), k_pages, v_pages))
+    x = _nn.rms_norm(x, norm_w, epsilon=eps)
+    logits = jnp.matmul(x, head_w.T if transpose_head else head_w)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, \
+        v_pages
+
+
+class LLMEngine:
+    """Continuous batching for LlamaForCausalLM-shaped models."""
+
+    def __init__(self, model, max_seqs: int = 8, max_len: int = 2048,
+                 page_size: int = 128, n_pages: Optional[int] = None,
+                 dtype=np.float32):
+        import jax.numpy as jnp
+
+        self.model = model
+        self.max_seqs = max_seqs
+        self.max_len = max_len
+        c = model.config
+        self.eps = c.rms_norm_eps
+        self.kvh = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        layers = model.llama.layers
+        if n_pages is None:
+            n_pages = max_seqs * (max_len // page_size) + 1
+        self.cache = PagedKVCache(
+            n_pages=n_pages, page_size=page_size, n_kv_heads=self.kvh,
+            head_dim=self.head_dim, max_seqs=max_seqs, max_len=max_len,
+            dtype=dtype, num_layers=len(layers))
+
+        def stackp(get):
+            return jnp.stack([get(l).value for l in layers])
+        self._stack = (
+            stackp(lambda l: l.input_layernorm.weight),
+            stackp(lambda l: l.self_attn.q_proj.weight),
+            stackp(lambda l: l.self_attn.k_proj.weight),
+            stackp(lambda l: l.self_attn.v_proj.weight),
+            stackp(lambda l: l.self_attn.o_proj.weight),
+            stackp(lambda l: l.post_attention_layernorm.weight),
+            stackp(lambda l: l.mlp.gate_proj.weight),
+            stackp(lambda l: l.mlp.up_proj.weight),
+            stackp(lambda l: l.mlp.down_proj.weight),
+        )
+        self._norm_w = model.llama.norm.weight.value
+        # tied embeddings: keep the [V, H] weight and transpose in-graph
+        # (an eager .T would hold a duplicate of the full vocab matrix)
+        self._tied = model.lm_head is None
+        self._head_w = model.lm_head.weight.value if not self._tied \
+            else model.llama.embed_tokens.weight.value
+        self._embed_w = model.llama.embed_tokens.weight.value
+        rope = np.asarray(model.llama.rope_cos.value), \
+            np.asarray(model.llama.rope_sin.value)
+        self._rope = (jnp.asarray(rope[0]), jnp.asarray(rope[1]))
+
+        self.requests: Dict[object, GenRequest] = {}
+        self._active: List[GenRequest] = []
+
+    # -- admission -------------------------------------------------------------
+    def add_request(self, rid, prompt_ids, max_new_tokens: int = 64,
+                    eos_token_id: Optional[int] = None):
+        """Prefill the prompt into pages; the request joins the decode
+        batch at the next step()."""
+        import jax.numpy as jnp
+
+        from ..tensor import Tensor
+
+        enforce(rid not in self.requests, f"duplicate request id {rid!r}")
+        enforce(max_new_tokens >= 1, "max_new_tokens must be >= 1")
+        req = GenRequest(rid, prompt_ids, max_new_tokens, eos_token_id)
+        total = len(req.prompt) + max_new_tokens
+        limit = min(self.max_len,
+                    self.model.config.max_position_embeddings)
+        enforce(total <= limit,
+                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine/model limit "
+                f"{limit}")
+        req.slot = self.cache.allocate(total)
+
+        # prefill via the model's standard static-cache path, then bulk
+        # scatter each layer's prompt K/V into this request's pages
+        ids = np.asarray(req.prompt, np.int32)[None]
+        caches = self.model.gen_static_caches(1, len(req.prompt))
+        self.model.eval()
+        logits, caches = self.model(
+            Tensor(jnp.asarray(ids)), caches=caches,
+            pos=Tensor(jnp.int32(0)), prefill=True)
+        k_all = jnp.stack([c.k.value[0] for c in caches])  # [L,S,KVH,D]
+        v_all = jnp.stack([c.v.value[0] for c in caches])
+        self.cache.write_prefill(req.slot, k_all, v_all)
+
+        first = int(np.asarray(logits.value[0, -1]).argmax())
+        req.out.append(first)
+        self.requests[rid] = req
+        # the prefill-produced token counts toward the limits too
+        if (req.eos is not None and first == req.eos) or \
+                req.max_new <= 1:
+            req.done = True
+            self.cache.release(req.slot)
+        else:
+            self._active.append(req)
+        return rid
+
+    # -- decode loop -----------------------------------------------------------
+    def step(self) -> Dict[object, int]:
+        """One decode token for every active request; returns
+        {request_id: new_token} and retires finished requests."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self._active:
+            return {}
+        batch = list(self._active)
+        n = len(batch)
+        # pad to max_seqs: continuous batching must keep ONE compiled
+        # shape as requests join/leave (dummy rows write into the
+        # reserved pad page 0 with len 0 and are discarded)
+        pad = self.max_seqs - n
+        slots = np.array([r.slot for r in batch])
+        tokens = np.array([r.out[-1] for r in batch] + [0] * pad,
+                          np.int32)
+        for s in slots:
+            self.cache.extend(int(s), 1)
+        lens = np.concatenate([self.cache.seq_lens[slots],
+                               np.zeros(pad, np.int32)])
+        tables = np.concatenate(
+            [self.cache.page_table[slots],
+             np.zeros((pad,) + self.cache.page_table.shape[1:],
+                      np.int32)])
+
+        nxt, self.cache.k_pages, self.cache.v_pages = _paged_decode_step(
+            self._stack, self._norm_w, self._head_w, self._embed_w,
+            self._rope, self.cache.k_pages, self.cache.v_pages,
+            jnp.asarray(tokens), jnp.asarray(lens, np.int32),
+            jnp.asarray(tables), jnp.asarray(lens, np.int32),
+            eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
+            transpose_head=self._tied)
+        self.cache.advance(slots, 1)
+        nxt = np.asarray(jax.device_get(nxt))[:n]
+
+        out = {}
+        for i, req in enumerate(batch):
+            tok = int(nxt[i])
+            req.out.append(tok)
+            out[req.rid] = tok
+            if (req.eos is not None and tok == req.eos) or \
+                    len(req.out) >= req.max_new:
+                req.done = True
+                self.cache.release(req.slot)
+                self._active.remove(req)
+        return out
+
+    def has_work(self) -> bool:
+        return bool(self._active)
+
+    def result(self, rid) -> List[int]:
+        return list(self.requests[rid].out)
