@@ -11,6 +11,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _tail_logs(logdir, prefix="", n=2000):
+    logs = ""
+    if logdir.exists():
+        for f in sorted(logdir.iterdir()):
+            logs += f"\n--- {prefix}{f.name} ---\n" + f.read_text()[-n:]
+    return logs
+
+
 def _clean_env():
     env = dict(os.environ)
     # the pytest session pins an 8-device cpu platform; workers set
@@ -31,11 +39,7 @@ def test_two_process_rendezvous(tmp_path):
          os.path.join(REPO, "tests", "launch_worker.py"), str(tmp_path)],
         env=_clean_env(), cwd=REPO, capture_output=True, text=True,
         timeout=240)
-    logs = ""
-    logdir = tmp_path / "logs"
-    if logdir.exists():
-        for f in sorted(logdir.iterdir()):
-            logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    logs = _tail_logs(tmp_path / "logs")
     assert out.returncode == 0, f"launch failed: {out.stderr}\n{logs}"
     result = (tmp_path / "result.txt").read_text()
     assert "psum=28.0" in result and "world=2" in result, result
@@ -103,3 +107,35 @@ def test_elastic_crash_resume_matches_uninterrupted(tmp_path):
     crashed = (crash_dir / "final_loss.txt").read_text()
     clean = (clean_dir / "final_loss.txt").read_text()
     assert crashed == clean, (crashed, clean)
+
+
+def test_multi_node_two_controllers(tmp_path):
+    """nnodes=2: one controller per 'node' (the reference's multi-node
+    deployment shape), sharing a master address — both workers join one
+    global mesh."""
+    from paddle_tpu.distributed.launch import free_port
+    master = f"127.0.0.1:{free_port()}"
+    worker = os.path.join(REPO, "tests", "launch_worker.py")
+    import time
+    procs = []
+    try:
+        for rank in (0, 1):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--node_rank", str(rank),
+                 "--nproc_per_node", "1", "--master", master,
+                 "--log_dir", str(tmp_path / f"logs{rank}"),
+                 worker, str(tmp_path)],
+                env=_clean_env(), cwd=REPO))
+        deadline = time.monotonic() + 240   # ONE shared budget
+        codes = [p.wait(timeout=max(1, deadline - time.monotonic()))
+                 for p in procs]
+    finally:
+        for p in procs:                      # a hung controller must not
+            if p.poll() is None:             # outlive the test
+                p.kill()
+    logs = "".join(_tail_logs(tmp_path / f"logs{r}", prefix=f"node{r}/",
+                              n=1500) for r in (0, 1))
+    assert codes == [0, 0], logs
+    result = (tmp_path / "result.txt").read_text()
+    assert "psum=28.0" in result and "world=2" in result, result
