@@ -1,2 +1,3 @@
 """paddle.incubate namespace parity (MoE et al., SURVEY.md §1 L7)."""
 from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

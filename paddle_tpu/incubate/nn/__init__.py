@@ -1,0 +1,102 @@
+"""paddle.incubate.nn — fused layer surface.
+
+Reference parity: python/paddle/incubate/nn (FusedTransformerEncoderLayer,
+FusedMultiHeadAttention, FusedFeedForward over phi fusion kernels).
+TPU-native: "fused" is XLA's job — these classes keep the incubate
+constructor signatures and route to the standard layers, whose attention
+already dispatches to the Pallas flash kernel; XLA fuses the rest.
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn.transformer import MultiHeadAttention, TransformerEncoderLayer
+from . import functional
+
+__all__ = ["FusedTransformerEncoderLayer", "FusedMultiHeadAttention",
+           "FusedFeedForward", "functional"]
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    """incubate.nn.FusedTransformerEncoderLayer signature over the
+    standard encoder layer (XLA performs the fusions the reference's
+    hand-written kernels provide)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(d_model, nhead, dim_feedforward,
+                         dropout=dropout_rate, activation=activation,
+                         attn_dropout=attn_dropout_rate,
+                         act_dropout=act_dropout_rate,
+                         normalize_before=normalize_before,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class FusedMultiHeadAttention(Layer):
+    """incubate.nn.FusedMultiHeadAttention: (pre|post)-LN + MHA +
+    dropout + residual, the reference's fused block structure, over the
+    standard MHA whose attention takes the flash path."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 weight_attr=None, bias_attr=None, epsilon=1e-5):
+        super().__init__()
+        from ...nn.common import Dropout
+        from ...nn.norm import LayerNorm
+        self.normalize_before = normalize_before
+        self.attn = MultiHeadAttention(
+            embed_dim, num_heads, dropout=attn_dropout_rate, kdim=kdim,
+            vdim=vdim, need_weights=need_weights,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        if self.normalize_before:
+            query = self.norm(query)
+        out = self.attn(query, key, value, attn_mask, cache)
+        if cache is not None:
+            out, cache = out
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return (out, cache) if cache is not None else out
+
+
+class FusedFeedForward(Layer):
+    """incubate.nn.FusedFeedForward: linear -> act -> dropout -> linear
+    (+ residual/LayerNorm per normalize_before)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5):
+        super().__init__()
+        from ...nn.common import Dropout, Linear
+        from ...nn.norm import LayerNorm
+        from ...nn.transformer import _get_activation
+        act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr)
+        self.dropout1 = Dropout(act_dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.activation = _get_activation(activation)
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.dropout1(self.activation(self.linear1(src)))
+        src = residual + self.dropout2(self.linear2(src))
+        if not self.normalize_before:
+            src = self.norm(src)
+        return src

@@ -1,0 +1,116 @@
+"""paddle.incubate.nn.functional — fused functional surface.
+
+The reference's fused phi kernels map to the framework's existing fused
+paths (flash attention, chunked linear+CE) or to compositions XLA fuses.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...ops.api import fused_linear  # noqa: F401
+from ...ops.api import fused_linear_cross_entropy  # noqa: F401
+
+__all__ = ["fused_linear", "fused_linear_cross_entropy",
+           "fused_multi_head_attention", "fused_feedforward",
+           "fused_rms_norm", "fused_layer_norm", "swiglu"]
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0,
+                               attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None):
+    """Reference fused_multi_head_attention signature (the common
+    subset): qkv_weight [3, num_heads, head_dim, embed_dim] packed (the
+    reference layout — num_heads comes from the weight); attention runs
+    the flash path.  cache_kv (incremental decode) is not ported here —
+    use nn.MultiHeadAttention's cache API or the generation engine."""
+    from ...common.errors import enforce
+    from ... import ops as P
+
+    enforce(cache_kv is None,
+            "fused_multi_head_attention: cache_kv is not supported — "
+            "use nn.MultiHeadAttention's Cache API or "
+            "inference.LLMEngine for incremental decode")
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    b, s, d = x.shape
+    if getattr(qkv_weight, "ndim", None) == 4:
+        _, nh, hd, _ = qkv_weight.shape      # reference packed layout
+    else:
+        enforce(num_heads is not None,
+                "pass num_heads (or a 4-D [3, heads, head_dim, embed] "
+                "qkv_weight it can be read from)")
+        nh = num_heads
+        hd = d // nh
+    qkv = P.matmul(P.reshape(x, [b * s, d]),
+                   P.reshape(qkv_weight, [3 * d, d]).T)
+    if qkv_bias is not None:
+        qkv = qkv + P.reshape(qkv_bias, [-1])
+    q, k, v = P.split(P.reshape(qkv, [b, s, 3, d]), 3, axis=2)
+
+    def heads(t):
+        return P.reshape(t, [b, s, nh, hd])
+    out = F.scaled_dot_product_attention(
+        heads(q), heads(k), heads(v), attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = P.matmul(P.reshape(out, [b, s, d]), linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate and training:
+        out = F.dropout(out, dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True):
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias,
+                         ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate and training:
+        h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        h = F.dropout(h, dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_rms_norm(x, scale, epsilon=1e-6):
+    return F.rms_norm(x, scale, epsilon=epsilon)
+
+
+def fused_layer_norm(x, scale=None, bias=None, epsilon=1e-5):
+    return F.layer_norm(x, x.shape[-1], scale, bias, epsilon)
+
+
+def swiglu(x, y=None):
+    """incubate swiglu: silu(x) * y (y defaults to the second half)."""
+    from ... import ops as P
+    if y is None:
+        x, y = P.split(x, 2, axis=-1)
+    return F.silu(x) * y
