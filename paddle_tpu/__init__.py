@@ -44,6 +44,12 @@ from . import sparse
 from . import audio
 from . import fft
 from . import distribution
+from . import linalg
+from . import regularizer
+from . import signal
+from . import utils
+from . import version
+__version__ = version.full_version
 
 # Subsystem imports land as modules are built (amp, distributed, hapi,
 # profiler are appended below once present).
@@ -58,4 +64,3 @@ CPUPlace = lambda: device.Place("cpu", 0)
 TPUPlace = lambda idx=0: device.Place("tpu", idx)
 CUDAPlace = TPUPlace  # accel alias
 
-__version__ = "0.1.0"

@@ -59,12 +59,16 @@ class FusedMultiHeadAttention(Layer):
         if self.normalize_before:
             query = self.norm(query)
         out = self.attn(query, key, value, attn_mask, cache)
-        if cache is not None:
-            out, cache = out
+        # MHA returns (out, cache) only for the incremental Cache type;
+        # StaticCache (and no cache) return the bare tensor
+        returned_cache = None
+        if cache is not None and not isinstance(
+                cache, MultiHeadAttention.StaticCache):
+            out, returned_cache = out
         out = residual + self.dropout(out)
         if not self.normalize_before:
             out = self.norm(out)
-        return (out, cache) if cache is not None else out
+        return out if returned_cache is None else (out, returned_cache)
 
 
 class FusedFeedForward(Layer):

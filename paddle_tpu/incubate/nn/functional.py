@@ -37,6 +37,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             "fused_multi_head_attention: cache_kv is not supported — "
             "use nn.MultiHeadAttention's Cache API or "
             "inference.LLMEngine for incremental decode")
+    enforce(ring_id == -1,
+            "fused_multi_head_attention: ring_id (tensor-parallel "
+            "allreduce) is not supported here — use the Megatron "
+            "parallel layers, whose collectives GSPMD emits")
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias,
@@ -66,7 +70,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     if linear_bias is not None:
         out = out + linear_bias
     if dropout_rate and training:
-        out = F.dropout(out, dropout_rate, training=training)
+        out = F.dropout(out, dropout_rate, training=training, mode=mode)
     if add_residual:
         out = residual + out
     if not pre_layer_norm:
@@ -86,8 +90,9 @@ def fused_feedforward(x, linear1_weight, linear2_weight,
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias,
                          ln1_epsilon)
+    from ...nn.transformer import _get_activation
     h = F.linear(x, linear1_weight, linear1_bias)
-    h = getattr(F, activation)(h)
+    h = _get_activation(activation)(h)
     if dropout1_rate and training:
         h = F.dropout(h, dropout1_rate, training=training)
     h = F.linear(h, linear2_weight, linear2_bias)

@@ -1,0 +1,50 @@
+"""paddle.linalg namespace (python/paddle/linalg.py parity): the
+tensorized linear-algebra surface re-exported under its public home.
+Implementations live in ops/_linalg.py (XLA lowerings; decompositions
+run on the TPU's QR/eig units where available, CPU callback otherwise).
+"""
+from .ops.api import (  # noqa: F401
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eigh, eigvalsh,
+    inv, lstsq, lu, matrix_norm, matrix_power, matrix_rank, norm, pinv,
+    qr, slogdet, solve, svd, triangular_solve, vector_norm,
+)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov",
+           "det", "eigh", "eigvalsh", "inv", "lstsq", "lu",
+           "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
+           "norm", "pinv", "qr", "slogdet", "solve", "svd",
+           "triangular_solve", "vector_norm"]
+
+
+def multi_dot(tensors):
+    """paddle.linalg.multi_dot: chain matmul with optimal association
+    order (classic matrix-chain DP on the host — shapes are static)."""
+    from . import ops as P
+    from .common.errors import enforce
+
+    enforce(len(tensors) >= 2, "multi_dot needs >= 2 tensors")
+    if len(tensors) == 2:
+        return P.matmul(tensors[0], tensors[1])
+    dims = [t.shape[0] for t in tensors] + [tensors[-1].shape[1]]
+    n = len(tensors)
+    cost = [[0] * n for _ in range(n)]
+    split = [[0] * n for _ in range(n)]
+    for length in range(2, n + 1):
+        for i in range(n - length + 1):
+            j = i + length - 1
+            cost[i][j] = float("inf")
+            for k in range(i, j):
+                c = (cost[i][k] + cost[k + 1][j]
+                     + dims[i] * dims[k + 1] * dims[j + 1])
+                if c < cost[i][j]:
+                    cost[i][j] = c
+                    split[i][j] = k
+
+    def build(i, j):
+        if i == j:
+            return tensors[i]
+        k = split[i][j]
+        from . import ops as P
+        return P.matmul(build(i, k), build(k + 1, j))
+
+    return build(0, n - 1)

@@ -1,0 +1,27 @@
+"""paddle.regularizer (L1Decay/L2Decay parity).
+
+The reference applies these inside the optimizer's weight update; here
+L2Decay maps onto the optimizers' decoupled/coupled weight_decay
+argument and L1Decay is applied as a gradient penalty by the functional
+optimizer core when attached via ParamAttr or the optimizer's
+``weight_decay=`` argument.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param)."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param (coupled form)."""

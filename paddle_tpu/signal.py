@@ -1,0 +1,96 @@
+"""paddle.signal — stft/istft (python/paddle/signal.py parity) over
+jnp FFT; window handling shared with paddle_tpu.audio."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .audio import functional as _afn
+from .common.errors import enforce
+from .tensor import Tensor, apply_op
+
+__all__ = ["stft", "istft"]
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """x [..., T] -> complex spectrogram [..., freq_bins, frames]."""
+    import jax.numpy as jnp
+
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is None:
+        win = np.ones(wl, np.float32)
+    else:
+        win = np.asarray(window.numpy() if isinstance(window, Tensor)
+                         else window, np.float32)
+    if wl < n_fft:
+        lp = (n_fft - wl) // 2
+        win = np.pad(win, (lp, n_fft - wl - lp))
+
+    def raw(a):
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        t = a.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop
+        idx = (jnp.arange(n_frames) * hop)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * win
+        fftfn = jnp.fft.rfft if onesided else jnp.fft.fft
+        spec = fftfn(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)
+    return apply_op(raw, x)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False, name=None):
+    """Inverse of :func:`stft` (overlap-add with window-square
+    normalization)."""
+    import jax.numpy as jnp
+
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is None:
+        win = np.ones(wl, np.float32)
+    else:
+        win = np.asarray(window.numpy() if isinstance(window, Tensor)
+                         else window, np.float32)
+    if wl < n_fft:
+        lp = (n_fft - wl) // 2
+        win = np.pad(win, (lp, n_fft - wl - lp))
+
+    def raw(spec):
+        s = jnp.swapaxes(spec, -1, -2)           # [..., frames, bins]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        ifftfn = jnp.fft.irfft if onesided else jnp.fft.ifft
+        frames = ifftfn(s, n=n_fft, axis=-1)
+        if not onesided:
+            frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        total = n_fft + hop * (n_frames - 1)
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (total,), frames.dtype)
+        wsum = jnp.zeros((total,), jnp.float32)
+        for i in range(n_frames):                # static loop (frames
+            sl = slice(i * hop, i * hop + n_fft)  # known at trace time)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(win.astype(jnp.float32) ** 2)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: total - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op(raw, x)

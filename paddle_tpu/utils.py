@@ -1,0 +1,64 @@
+"""paddle.utils parity (the commonly-imported helpers)."""
+from __future__ import annotations
+
+import importlib
+import threading
+
+__all__ = ["try_import", "unique_name", "deprecated", "run_check"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """paddle.utils.try_import: import or raise a friendly error."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required; it is not "
+                       f"bundled with this TPU build")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+
+    def generate(self, key: str = "tmp") -> str:
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+
+unique_name = _UniqueNameGenerator()
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator parity; warns once per call site."""
+    import warnings
+
+    def deco(fn):
+        def wrapper(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason} "
+                f"{('use ' + update_to) if update_to else ''}",
+                DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        wrapper.__name__ = fn.__name__
+        return wrapper
+    return deco
+
+
+def run_check():
+    """paddle.utils.run_check: one-device smoke (prints the verdict)."""
+    import numpy as np
+
+    from . import ops
+    from .runtime.device import get_device
+    from .tensor import to_tensor
+    out = ops.matmul(to_tensor(np.ones((2, 2), np.float32)),
+                     to_tensor(np.ones((2, 2), np.float32)))
+    ok = bool((np.asarray(out.numpy()) == 2.0).all())
+    print(f"PaddlePaddle(TPU build) works on {get_device()}: "
+          f"{'OK' if ok else 'FAILED'}")
+    return ok

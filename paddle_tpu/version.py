@@ -1,0 +1,25 @@
+"""paddle.version parity: version metadata for recipe compatibility
+checks (`paddle.version.full_version`, `paddle.__version__`)."""
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"    # no CUDA anywhere, by design
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print("cuda: False (TPU-native build)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -92,3 +92,35 @@ def test_fused_mha_layer_residual_and_ln():
     # post-LN applied: per-position mean ~0 for the default config
     vals = np.asarray(out.numpy())
     np.testing.assert_allclose(vals.mean(-1), 0.0, atol=1e-5)
+
+
+def test_fused_mha_static_cache_not_misunpacked():
+    from paddle_tpu.nn.transformer import MultiHeadAttention
+    paddle.seed(0)
+    m = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0)
+    m.eval()
+    x = _t(np.random.default_rng(0).normal(size=(3, 5, 32)))
+    sc = m.attn.gen_cache(x, type=MultiHeadAttention.StaticCache)
+    out = m(x, cache=sc)
+    assert not isinstance(out, tuple)
+    assert tuple(out.shape) == (3, 5, 32)
+
+
+def test_fused_mha_functional_rejects_ring_id():
+    rng = np.random.default_rng(0)
+    x = _t(rng.normal(size=(1, 4, 32)))
+    qkv = _t(rng.normal(size=(3, 4, 8, 32)))
+    w = _t(rng.normal(size=(32, 32)))
+    with pytest.raises(Exception):
+        iF.fused_multi_head_attention(x, qkv, w, ring_id=0)
+
+
+def test_fused_feedforward_rejects_bogus_activation():
+    rng = np.random.default_rng(0)
+    x = _t(rng.normal(size=(1, 4, 16)))
+    w1 = _t(rng.normal(size=(16, 32)))
+    w2 = _t(rng.normal(size=(32, 16)))
+    with pytest.raises(Exception):
+        iF.fused_feedforward(x, w1, w2, activation="dropout",
+                             dropout1_rate=0.0, dropout2_rate=0.0)

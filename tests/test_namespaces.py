@@ -1,0 +1,53 @@
+"""Top-level namespace parity: linalg, regularizer, signal, utils,
+version."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_linalg_namespace():
+    a = np.eye(3, dtype=np.float32) * 2
+    assert abs(float(paddle.linalg.det(_t(a)).numpy()) - 8.0) < 1e-5
+    q, r = paddle.linalg.qr(_t(np.random.default_rng(0)
+                               .normal(size=(4, 3)).astype(np.float32)))
+    np.testing.assert_allclose(
+        np.asarray(paddle.matmul(q, r).numpy()).shape, (4, 3))
+
+
+def test_multi_dot_matches_chain():
+    rng = np.random.default_rng(0)
+    mats = [rng.normal(size=s).astype(np.float32)
+            for s in [(2, 40), (40, 3), (3, 30)]]
+    got = paddle.linalg.multi_dot([_t(m) for m in mats])
+    want = mats[0] @ mats[1] @ mats[2]
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_signal_stft_istft_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 2048)).astype(np.float32)
+    win = paddle.to_tensor(np.hanning(257)[:-1].astype(np.float32))
+    spec = paddle.signal.stft(_t(x), n_fft=256, hop_length=64,
+                              window=win)
+    assert np.iscomplexobj(np.asarray(spec.numpy()))
+    back = paddle.signal.istft(spec, n_fft=256, hop_length=64,
+                               window=win, length=2048)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-3)
+
+
+def test_utils_and_version_and_regularizer():
+    assert paddle.utils.try_import("math") is not None
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+    n1 = paddle.utils.unique_name.generate("fc")
+    n2 = paddle.utils.unique_name.generate("fc")
+    assert n1 != n2
+    assert paddle.utils.run_check()
+    assert paddle.__version__ == paddle.version.full_version
+    assert paddle.regularizer.L2Decay(0.01).coeff == 0.01
