@@ -23,8 +23,18 @@ def multi_dot(tensors):
     from .common.errors import enforce
 
     enforce(len(tensors) >= 2, "multi_dot needs >= 2 tensors")
+    # paddle allows 1-D endpoints: promote to row/column vectors and
+    # squeeze the result back
+    head_vec = len(tensors[0].shape) == 1
+    tail_vec = len(tensors[-1].shape) == 1
+    tensors = list(tensors)
+    if head_vec:
+        tensors[0] = P.reshape(tensors[0], [1, -1])
+    if tail_vec:
+        tensors[-1] = P.reshape(tensors[-1], [-1, 1])
     if len(tensors) == 2:
-        return P.matmul(tensors[0], tensors[1])
+        out = P.matmul(tensors[0], tensors[1])
+        return _squeeze_ends(out, head_vec, tail_vec)
     dims = [t.shape[0] for t in tensors] + [tensors[-1].shape[1]]
     n = len(tensors)
     cost = [[0] * n for _ in range(n)]
@@ -47,4 +57,13 @@ def multi_dot(tensors):
         from . import ops as P
         return P.matmul(build(i, k), build(k + 1, j))
 
-    return build(0, n - 1)
+    return _squeeze_ends(build(0, n - 1), head_vec, tail_vec)
+
+
+def _squeeze_ends(out, head_vec, tail_vec):
+    from . import ops as P
+    if tail_vec:
+        out = P.squeeze(out, axis=-1)
+    if head_vec:
+        out = P.squeeze(out, axis=0)
+    return out

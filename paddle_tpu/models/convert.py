@@ -1,0 +1,147 @@
+"""Checkpoint conversion: HuggingFace/torch weights -> paddle_tpu models.
+
+The migration story ("switch from the reference and bring your
+weights"): torch-format checkpoints (pytorch_model.bin / *.safetensors,
+loaded with the bundled CPU torch) are renamed and re-laid-out into
+this framework's state_dicts.  Two layout rules cover almost
+everything:
+
+* torch ``nn.Linear`` stores ``[out, in]``; paddle Linear stores
+  ``[in, out]`` -> every ``*_proj/linear/dense`` weight is transposed.
+* Embeddings / norms are layout-identical.
+
+Supported families: Llama (HF ``LlamaForCausalLM``) and BERT
+(HF ``BertModel``/``BertFor*``); the mapping tables are data, so new
+families are one dict away.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.errors import enforce
+
+__all__ = ["load_torch_checkpoint", "convert_hf_llama",
+           "convert_hf_bert", "load_hf_llama", "load_hf_bert"]
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Load a torch .bin/.pt (pickle) or .safetensors file into numpy."""
+    if path.endswith(".safetensors"):
+        # via torch: numpy has no bfloat16, and stock HF checkpoints are
+        # bf16 — upcast to f32 on the way through
+        from safetensors.torch import load_file
+        return {k: v.to(dtype=__import__("torch").float32).numpy()
+                if v.dtype == __import__("torch").bfloat16 else v.numpy()
+                for k, v in load_file(path).items()}
+    import torch
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    return {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            for k, v in state.items()}
+
+
+def _apply(model, mapped: Dict[str, np.ndarray]
+           ) -> Tuple[List[str], List[str]]:
+    own = dict(model.named_parameters())
+    missing = [k for k in own if k not in mapped]
+    unexpected = [k for k in mapped if k not in own]
+    for name, arr in mapped.items():
+        p = own.get(name)
+        if p is None:
+            continue
+        enforce(tuple(arr.shape) == tuple(p.shape),
+                f"converted weight {name!r}: shape {arr.shape} vs model "
+                f"{tuple(p.shape)}")
+        p.set_value(np.ascontiguousarray(arr))
+    return missing, unexpected
+
+
+# ---------------------------------------------------------------------------
+# Llama (HF LlamaForCausalLM layout)
+# ---------------------------------------------------------------------------
+
+_LLAMA_TRANSPOSE = re.compile(
+    r"(q_proj|k_proj|v_proj|o_proj|gate_proj|up_proj|down_proj|lm_head)"
+    r"\.weight$")
+
+
+def convert_hf_llama(state: Dict[str, np.ndarray]
+                     ) -> Dict[str, np.ndarray]:
+    """HF ``model.layers.N...`` names -> ``llama.layers.N...`` (this
+    framework's LlamaForCausalLM), transposing linear weights."""
+    out = {}
+    for k, v in state.items():
+        nk = k
+        if nk.startswith("model."):
+            nk = "llama." + nk[len("model."):]
+        if _LLAMA_TRANSPOSE.search(nk):
+            v = np.asarray(v).T
+        if "rotary_emb" in nk:        # recomputed, not a parameter
+            continue
+        out[nk] = np.asarray(v)
+    return out
+
+
+def load_hf_llama(model, path: str) -> Tuple[List[str], List[str]]:
+    """Load an HF Llama checkpoint file into ``model`` in place; returns
+    (missing, unexpected) parameter names."""
+    return _apply(model, convert_hf_llama(load_torch_checkpoint(path)))
+
+
+# ---------------------------------------------------------------------------
+# BERT (HF BertModel layout)
+# ---------------------------------------------------------------------------
+
+_BERT_RENAMES = [
+    (r"^bert\.", ""),
+    (r"embeddings\.LayerNorm\.", "embeddings.layer_norm."),
+    (r"encoder\.layer\.(\d+)\.attention\.self\.query\.",
+     r"encoder.layers.\1.self_attn.q_proj."),
+    (r"encoder\.layer\.(\d+)\.attention\.self\.key\.",
+     r"encoder.layers.\1.self_attn.k_proj."),
+    (r"encoder\.layer\.(\d+)\.attention\.self\.value\.",
+     r"encoder.layers.\1.self_attn.v_proj."),
+    (r"encoder\.layer\.(\d+)\.attention\.output\.dense\.",
+     r"encoder.layers.\1.self_attn.out_proj."),
+    (r"encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.",
+     r"encoder.layers.\1.norm1."),
+    (r"encoder\.layer\.(\d+)\.intermediate\.dense\.",
+     r"encoder.layers.\1.linear1."),
+    (r"encoder\.layer\.(\d+)\.output\.dense\.",
+     r"encoder.layers.\1.linear2."),
+    (r"encoder\.layer\.(\d+)\.output\.LayerNorm\.",
+     r"encoder.layers.\1.norm2."),
+]
+
+_BERT_TRANSPOSE = re.compile(
+    r"(q_proj|k_proj|v_proj|out_proj|linear1|linear2|pooler\.dense|"
+    r"classifier)\.weight$")
+
+
+def convert_hf_bert(state: Dict[str, np.ndarray], prefix: str = "bert."
+                    ) -> Dict[str, np.ndarray]:
+    """HF bert names -> this framework's BertModel names (use
+    ``prefix`` for where BertModel sits in the target, e.g. ``"bert."``
+    inside BertForSequenceClassification or ``""`` standalone)."""
+    out = {}
+    for k, v in state.items():
+        nk = k
+        for pat, rep in _BERT_RENAMES:
+            nk = re.sub(pat, rep, nk)
+        if "position_ids" in nk:      # HF buffer, not a parameter
+            continue
+        if _BERT_TRANSPOSE.search(nk):
+            v = np.asarray(v).T
+        out[prefix + nk] = np.asarray(v)
+    return out
+
+
+def load_hf_bert(model, path: str, prefix: str = ""
+                 ) -> Tuple[List[str], List[str]]:
+    return _apply(model, convert_hf_bert(load_torch_checkpoint(path),
+                                         prefix=prefix))

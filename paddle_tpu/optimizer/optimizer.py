@@ -35,6 +35,9 @@ class Optimizer:
         self._weight_decay = 0.0 if weight_decay is None else (
             weight_decay if isinstance(weight_decay, float) else
             getattr(weight_decay, "coeff", 0.0))
+        # paddle.regularizer.L1Decay means coeff*sign(param), not the L2
+        # form — silently applying L2 would diverge from the reference
+        self._l1_decay = type(weight_decay).__name__ == "L1Decay"
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._slots: Dict[int, Dict[str, jax.Array]] = {}
@@ -87,7 +90,10 @@ class Optimizer:
             if g.dtype != p.value.dtype:
                 g = g.astype(p.value.dtype)
             if self._weight_decay and not self._decoupled_weight_decay():
-                g = g + self._weight_decay * p.value
+                if self._l1_decay:
+                    g = g + self._weight_decay * jnp.sign(p.value)
+                else:
+                    g = g + self._weight_decay * p.value
             slots = self._slots.get(id(p))
             if slots is None:
                 slots = self.init_slots(p.value)

@@ -6,11 +6,23 @@ from typing import Optional
 
 import numpy as np
 
-from .audio import functional as _afn
-from .common.errors import enforce
 from .tensor import Tensor, apply_op
 
 __all__ = ["stft", "istft"]
+
+
+def _prepare_window(n_fft: int, win_length: Optional[int], window
+                    ) -> np.ndarray:
+    wl = win_length or n_fft
+    if window is None:
+        win = np.ones(wl, np.float32)
+    else:
+        win = np.asarray(window.numpy() if isinstance(window, Tensor)
+                         else window, np.float32)
+    if wl < n_fft:
+        lp = (n_fft - wl) // 2
+        win = np.pad(win, (lp, n_fft - wl - lp))
+    return win
 
 
 def stft(x, n_fft: int, hop_length: Optional[int] = None,
@@ -21,15 +33,7 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     import jax.numpy as jnp
 
     hop = hop_length or n_fft // 4
-    wl = win_length or n_fft
-    if window is None:
-        win = np.ones(wl, np.float32)
-    else:
-        win = np.asarray(window.numpy() if isinstance(window, Tensor)
-                         else window, np.float32)
-    if wl < n_fft:
-        lp = (n_fft - wl) // 2
-        win = np.pad(win, (lp, n_fft - wl - lp))
+    win = _prepare_window(n_fft, win_length, window)
 
     def raw(a):
         if center:
@@ -59,15 +63,7 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     import jax.numpy as jnp
 
     hop = hop_length or n_fft // 4
-    wl = win_length or n_fft
-    if window is None:
-        win = np.ones(wl, np.float32)
-    else:
-        win = np.asarray(window.numpy() if isinstance(window, Tensor)
-                         else window, np.float32)
-    if wl < n_fft:
-        lp = (n_fft - wl) // 2
-        win = np.pad(win, (lp, n_fft - wl - lp))
+    win = _prepare_window(n_fft, win_length, window)
 
     def raw(spec):
         s = jnp.swapaxes(spec, -1, -2)           # [..., frames, bins]
@@ -75,18 +71,20 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
             s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
         ifftfn = jnp.fft.irfft if onesided else jnp.fft.ifft
         frames = ifftfn(s, n=n_fft, axis=-1)
-        if not onesided:
+        if not onesided and not return_complex:
             frames = frames.real
         frames = frames * win
         n_frames = frames.shape[-2]
         total = n_fft + hop * (n_frames - 1)
         lead = frames.shape[:-2]
+        # ONE scatter-add does the whole overlap-add (duplicate indices
+        # accumulate); an unrolled per-frame loop traces O(frames) ops
+        idx = (jnp.arange(n_frames) * hop)[:, None] + \
+            jnp.arange(n_fft)[None, :]               # [frames, n_fft]
         out = jnp.zeros(lead + (total,), frames.dtype)
-        wsum = jnp.zeros((total,), jnp.float32)
-        for i in range(n_frames):                # static loop (frames
-            sl = slice(i * hop, i * hop + n_fft)  # known at trace time)
-            out = out.at[..., sl].add(frames[..., i, :])
-            wsum = wsum.at[sl].add(win.astype(jnp.float32) ** 2)
+        out = out.at[..., idx].add(frames)
+        wsum = jnp.zeros((total,), jnp.float32).at[idx].add(
+            win.astype(jnp.float32) ** 2)
         out = out / jnp.maximum(wsum, 1e-10)
         if center:
             out = out[..., n_fft // 2: total - n_fft // 2]

@@ -97,6 +97,14 @@ class Tensor:
         return self._node is None
 
     @property
+    def trainable(self) -> bool:
+        return not self._stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self._stop_gradient = not v
+
+    @property
     def place(self):
         devs = getattr(self._value, "devices", None)
         return next(iter(devs())) if callable(devs) else None

@@ -1,6 +1,7 @@
 """paddle.utils parity (the commonly-imported helpers)."""
 from __future__ import annotations
 
+import functools
 import importlib
 import threading
 
@@ -38,13 +39,13 @@ def deprecated(update_to: str = "", since: str = "", reason: str = "",
     import warnings
 
     def deco(fn):
+        @functools.wraps(fn)
         def wrapper(*a, **k):
             warnings.warn(
                 f"{fn.__name__} is deprecated since {since}: {reason} "
                 f"{('use ' + update_to) if update_to else ''}",
                 DeprecationWarning, stacklevel=2)
             return fn(*a, **k)
-        wrapper.__name__ = fn.__name__
         return wrapper
     return deco
 

@@ -51,3 +51,28 @@ def test_utils_and_version_and_regularizer():
     assert paddle.utils.run_check()
     assert paddle.__version__ == paddle.version.full_version
     assert paddle.regularizer.L2Decay(0.01).coeff == 0.01
+
+
+def test_multi_dot_1d_endpoints():
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=(5,)).astype(np.float32)
+    A = rng.normal(size=(5, 6)).astype(np.float32)
+    B = rng.normal(size=(6, 4)).astype(np.float32)
+    w = rng.normal(size=(4,)).astype(np.float32)
+    got = paddle.linalg.multi_dot([_t(v), _t(A), _t(B), _t(w)])
+    want = v @ A @ B @ w
+    np.testing.assert_allclose(float(got.numpy()), want, rtol=1e-4)
+
+
+def test_l1_decay_applies_sign_penalty():
+    from paddle_tpu import optimizer as opt
+    p = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+    p.stop_gradient = False
+    sgd = opt.SGD(learning_rate=1.0, parameters=[p],
+                  weight_decay=paddle.regularizer.L1Decay(0.5))
+    loss = paddle.sum(p * 0.0)
+    loss.backward()
+    sgd.step()
+    # grad = 0 + 0.5 * sign(p) -> p -= [0.5, -0.5]
+    np.testing.assert_allclose(np.asarray(p.numpy()), [1.5, -2.5],
+                               rtol=1e-6)
