@@ -55,7 +55,8 @@ def init(role_maker=None, is_collective: bool = True,
     _STRATEGY = strategy
     hybrid = strategy.hybrid
     n_needed = (hybrid.dp_degree * hybrid.mp_degree * hybrid.pp_degree *
-                hybrid.sharding_degree * hybrid.sep_degree)
+                hybrid.sharding_degree * hybrid.sep_degree *
+                hybrid.ep_degree)
     n_have = len(jax.devices())
     if n_needed == 1 and n_have > 1:
         # no explicit topology: default all devices to dp (reference

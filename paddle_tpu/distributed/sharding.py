@@ -55,6 +55,8 @@ def plan_param_spec(param, mesh: Mesh, stage: int,
         keep = []
         size = 1
         for a in axes:
+            if a not in mesh.axis_names:   # e.g. ep on a non-MoE mesh
+                continue
             a_sz = _axis_size(mesh, a)
             if param.shape[i] % (size * a_sz) == 0:
                 keep.append(a)

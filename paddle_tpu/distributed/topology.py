@@ -25,18 +25,21 @@ from .strategy import HybridConfig
 
 __all__ = ["HybridCommunicateGroup", "CommGroup", "build_mesh"]
 
-AXES = ("pp", "dp", "sharding", "sep", "mp")
+AXES = ("pp", "dp", "sharding", "ep", "sep", "mp")
 
 
 def build_mesh(hybrid: HybridConfig, devices: Optional[Sequence] = None
                ) -> Mesh:
-    """Mesh with axis order (pp, dp, sharding, sep, mp) — the reference's
-    topology order, which also places mp on the innermost (fastest-ICI)
-    axis, matching TPU torus locality best practice (scaling-book recipe:
-    innermost mesh dim ↔ highest-bandwidth links)."""
+    """Mesh with axis order (pp, dp, sharding, ep, sep, mp) — the
+    reference's topology order plus a dedicated expert-parallel axis,
+    which also places mp on the innermost (fastest-ICI) axis, matching
+    TPU torus locality best practice (scaling-book recipe: innermost
+    mesh dim ↔ highest-bandwidth links).  The ep axis sits next to
+    sharding so the MoE all-to-all rides the same ICI neighborhood as
+    the ZeRO collectives."""
     devices = list(devices if devices is not None else jax.devices())
     shape = (hybrid.pp_degree, hybrid.dp_degree, hybrid.sharding_degree,
-             hybrid.sep_degree, hybrid.mp_degree)
+             hybrid.ep_degree, hybrid.sep_degree, hybrid.mp_degree)
     n = int(np.prod(shape))
     enforce(n <= len(devices),
             f"topology {shape} needs {n} devices, have {len(devices)}")
@@ -116,6 +119,8 @@ class HybridCommunicateGroup:
         return CommGroup(self.mesh, ("sep",))
 
     def get_expert_parallel_group(self) -> CommGroup:
+        if self._hybrid.ep_degree > 1:
+            return CommGroup(self.mesh, ("ep",))
         # EP reuses dp×sharding capacity (DeepSpeed-MoE style folding)
         return CommGroup(self.mesh, ("dp", "sharding"))
 

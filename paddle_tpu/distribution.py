@@ -223,7 +223,8 @@ class Exponential(Distribution):
     def log_prob(self, value):
         def raw(rate, v):
             import jax.numpy as jnp
-            return jnp.log(rate) - rate * v
+            # support check: density is zero (log -inf) below 0
+            return jnp.where(v >= 0, jnp.log(rate) - rate * v, -jnp.inf)
         return apply_op(raw, self.rate, value)
 
 

@@ -211,7 +211,11 @@ class Model:
                 if self._metrics:
                     # metrics cost a second jitted forward (the fused
                     # step returns only the loss); its post-update
-                    # eval-mode loss must NOT shadow the train loss
+                    # eval-mode loss must NOT shadow the train loss.
+                    # Known drift vs paddle: these metrics see the
+                    # POST-update weights (paddle computes them on the
+                    # same forward as the loss) — one optimizer step of
+                    # skew, vanishing as training converges
                     ev = self.eval_batch(ins, labs)
                     mlogs = self._update_metrics(ev, labs)
                     mlogs.pop("loss", None)

@@ -14,6 +14,14 @@ bulk-writes the prompt K/V into the request's pages.
 The dense jitted ``generate()`` remains the single-tenant fast path;
 this engine is the multi-tenant path where requests join and leave
 between steps (continuous batching).
+
+Serving-shape discipline: admission pads prompts to power-of-two
+**length buckets** so a mixed-length request stream compiles once per
+bucket, not once per length (the reference's serving stacks bucket the
+same way; causal attention makes end-padding sound — padded positions
+can never influence real ones).  ``prefill_compiles()`` /
+``decode_compiles()`` expose the jit cache sizes so ops can assert the
+no-recompile property.
 """
 from __future__ import annotations
 
@@ -26,6 +34,15 @@ from ..common.errors import enforce
 from .paged_cache import PagedKVCache
 
 __all__ = ["LLMEngine", "GenRequest"]
+
+
+def _bucket_len(n: int, lo: int = 16) -> int:
+    """Smallest power-of-two >= n (min ``lo``) — the prefill length
+    bucket."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
 
 
 class GenRequest:
@@ -41,12 +58,93 @@ class GenRequest:
 
 @functools.partial(
     __import__("jax").jit,
-    static_argnames=("eps", "kvh", "head_dim", "transpose_head"),
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head"))
+def _paged_prefill(stack, norm_w, head_w, embed_w, rope, ids, last_idx,
+                   *, eps: float, kvh: int, head_dim: int,
+                   transpose_head: bool = False):
+    """Prefill ONE prompt padded to a length bucket: ids [S] int32
+    (end-padded), last_idx = real_len - 1.
+
+    Returns (logits_last [V], k_all [L, S, KVH, D], v_all [...]) — the
+    caller slices K/V to the real length before the page scatter, so
+    padding rows never reach the cache.  One XLA program per (bucket,
+    model) pair; causal attention keeps padded positions invisible to
+    real ones.
+
+    This re-states the llama decoder math over stacked [L, ...] weights
+    (like _paged_decode_step below) rather than calling the Layer
+    graph; the guard against divergence is
+    tests/test_engine.py::test_single_request_matches_generate, which
+    pins engine prefill+decode token-exactly to model.generate()."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+    from ..runtime.device import is_compiled_with_tpu
+
+    cos_t, sin_t = rope
+    s = ids.shape[0]
+    x = jnp.take(embed_w, ids, axis=0)                  # [S, H]
+    cos = cos_t[:s][None, :, None, :]                   # [1, S, 1, D]
+    sin = sin_t[:s][None, :, None, :]
+
+    from ..models.llama import _rotate_half as rotate_half
+
+    def attend(q, k, v):
+        # q/k/v [S, H(K), D] -> causal attention [S, H, D]
+        if is_compiled_with_tpu():
+            from ..ops.pallas.flash_attention import flash_attention_raw
+            try:
+                return flash_attention_raw(q[None], k[None], v[None],
+                                           causal=True)[0]
+            except NotImplementedError:
+                pass  # tiny/odd dims: jnp reference below
+        g = q.shape[1] // k.shape[1]
+        qg = q.reshape(s, k.shape[1], g, head_dim)
+        sc = jnp.einsum("qhgd,khd->hgqk", qg.astype(jnp.float32),
+                        k.astype(jnp.float32))
+        sc = sc / jnp.sqrt(jnp.float32(head_dim))
+        mask = jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]
+        sc = jnp.where(mask[None, None], sc, -jnp.inf)
+        p = jax.nn.softmax(sc, axis=-1)
+        o = jnp.einsum("hgqk,khd->qhgd", p, v.astype(jnp.float32))
+        return o.reshape(s, q.shape[1], head_dim).astype(q.dtype)
+
+    def layer(carry, lp):
+        hcur = carry
+        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
+        nh = qw.shape[1] // head_dim
+        q = jnp.matmul(hn, qw).reshape(s, nh, head_dim)
+        k = jnp.matmul(hn, kw).reshape(s, kvh, head_dim)
+        v = jnp.matmul(hn, vw).reshape(s, kvh, head_dim)
+        qf, kf = q.astype(jnp.float32)[None], k.astype(jnp.float32)[None]
+        q = (qf * cos + rotate_half(qf) * sin)[0].astype(q.dtype)
+        k = (kf * cos + rotate_half(kf) * sin)[0].astype(k.dtype)
+        attn = attend(q, k, v)
+        hcur = hcur + jnp.matmul(attn.reshape(s, nh * head_dim), ow)
+        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
+        ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
+        return hcur + jnp.matmul(ff, dw), (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer, x, tuple(stack))
+    x = _nn.rms_norm(x, norm_w, epsilon=eps)
+    xl = jnp.take(x, last_idx, axis=0)                  # [H]
+    logits = jnp.matmul(xl, head_w.T if transpose_head else head_w)
+    return logits, k_all, v_all
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature"),
     donate_argnames=("k_pages", "v_pages"))
 def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                        k_pages, v_pages, tokens, positions, tables, lens,
-                       *, eps: float, kvh: int, head_dim: int,
-                       transpose_head: bool = False):
+                       key, *, eps: float, kvh: int, head_dim: int,
+                       transpose_head: bool = False,
+                       strategy: str = "greedy_search", top_k: int = 0,
+                       top_p: float = 1.0, temperature: float = 1.0):
     """One decode token for every active sequence.
 
     stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
@@ -100,8 +198,10 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
         layer, x, (tuple(stack), k_pages, v_pages))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
     logits = jnp.matmul(x, head_w.T if transpose_head else head_w)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, \
-        v_pages
+    from ..nn.generation import sample_logits
+    nxt, _ = sample_logits(logits, key, strategy=strategy, top_k=top_k,
+                           top_p=top_p, temperature=temperature)
+    return nxt, k_pages, v_pages
 
 
 class LLMEngine:
@@ -109,9 +209,19 @@ class LLMEngine:
 
     def __init__(self, model, max_seqs: int = 8, max_len: int = 2048,
                  page_size: int = 128, n_pages: Optional[int] = None,
-                 dtype=np.float32):
+                 dtype=np.float32, decode_strategy: str = "greedy_search",
+                 top_k: int = 0, top_p: float = 1.0,
+                 temperature: float = 1.0, seed: int = 0):
+        import jax
         import jax.numpy as jnp
 
+        enforce(decode_strategy in ("greedy_search", "sampling"),
+                f"unsupported decode_strategy {decode_strategy!r}")
+        self.decode_strategy = decode_strategy
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.temperature = float(temperature)
+        self._key = jax.random.PRNGKey(seed)
         self.model = model
         self.max_seqs = max_seqs
         self.max_len = max_len
@@ -158,36 +268,52 @@ class LLMEngine:
     def add_request(self, rid, prompt_ids, max_new_tokens: int = 64,
                     eos_token_id: Optional[int] = None):
         """Prefill the prompt into pages; the request joins the decode
-        batch at the next step()."""
-        import jax.numpy as jnp
+        batch at the next step().
 
-        from ..tensor import Tensor
+        The prompt is end-padded to a power-of-two length bucket, so a
+        mixed-length request stream costs one prefill compile per
+        BUCKET (assert with ``prefill_compiles()``), not per length —
+        the round-2 per-prompt-recompile admission stall is gone."""
+        import jax
+        import jax.numpy as jnp
 
         enforce(rid not in self.requests, f"duplicate request id {rid!r}")
         enforce(max_new_tokens >= 1, "max_new_tokens must be >= 1")
         req = GenRequest(rid, prompt_ids, max_new_tokens, eos_token_id)
-        total = len(req.prompt) + max_new_tokens
+        plen = len(req.prompt)
+        enforce(plen >= 1, "empty prompt")
+        total = plen + max_new_tokens
         limit = min(self.max_len,
                     self.model.config.max_position_embeddings)
         enforce(total <= limit,
-                f"prompt ({len(req.prompt)}) + max_new_tokens "
+                f"prompt ({plen}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the engine/model limit "
                 f"{limit}")
         req.slot = self.cache.allocate(total)
 
-        # prefill via the model's standard static-cache path, then bulk
-        # scatter each layer's prompt K/V into this request's pages
-        ids = np.asarray(req.prompt, np.int32)[None]
-        caches = self.model.gen_static_caches(1, len(req.prompt))
-        self.model.eval()
-        logits, caches = self.model(
-            Tensor(jnp.asarray(ids)), caches=caches,
-            pos=Tensor(jnp.int32(0)), prefill=True)
-        k_all = jnp.stack([c.k.value[0] for c in caches])  # [L,S,KVH,D]
-        v_all = jnp.stack([c.v.value[0] for c in caches])
-        self.cache.write_prefill(req.slot, k_all, v_all)
+        # bucketed single-sequence prefill (one compile per bucket),
+        # then bulk-scatter the REAL prompt K/V rows into the pages.
+        # Clamp to ``limit``: the rope tables only have
+        # max_position_embeddings rows, so the tail bucket is the limit
+        # itself (plen <= limit is already enforced above)
+        bucket = min(_bucket_len(plen), limit)
+        ids = np.zeros(bucket, np.int32)
+        ids[:plen] = np.asarray(req.prompt, np.int32)
+        logits, k_all, v_all = _paged_prefill(
+            self._stack, self._norm_w, self._head_w, self._embed_w,
+            self._rope, jnp.asarray(ids), jnp.int32(plen - 1),
+            eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
+            transpose_head=self._tied)
+        self.cache.write_prefill(req.slot, k_all[:, :plen],
+                                 v_all[:, :plen])
 
-        first = int(np.asarray(logits.value[0, -1]).argmax())
+        self._key, sub = jax.random.split(self._key)
+        from ..nn.generation import sample_logits
+        first_tok, _ = sample_logits(
+            logits[None], sub, strategy=self.decode_strategy,
+            top_k=self.top_k, top_p=self.top_p,
+            temperature=self.temperature)
+        first = int(np.asarray(first_tok)[0])
         req.out.append(first)
         self.requests[rid] = req
         # the prefill-produced token counts toward the limits too
@@ -226,13 +352,16 @@ class LLMEngine:
              np.zeros((pad,) + self.cache.page_table.shape[1:],
                       np.int32)])
 
+        self._key, sub = jax.random.split(self._key)
         nxt, self.cache.k_pages, self.cache.v_pages = _paged_decode_step(
             self._stack, self._norm_w, self._head_w, self._embed_w,
             self._rope, self.cache.k_pages, self.cache.v_pages,
             jnp.asarray(tokens), jnp.asarray(lens, np.int32),
-            jnp.asarray(tables), jnp.asarray(lens, np.int32),
+            jnp.asarray(tables), jnp.asarray(lens, np.int32), sub,
             eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
-            transpose_head=self._tied)
+            transpose_head=self._tied, strategy=self.decode_strategy,
+            top_k=self.top_k, top_p=self.top_p,
+            temperature=self.temperature)
         self.cache.advance(slots, 1)
         nxt = np.asarray(jax.device_get(nxt))[:n]
 
@@ -253,3 +382,14 @@ class LLMEngine:
 
     def result(self, rid) -> List[int]:
         return list(self.requests[rid].out)
+
+    # -- observability ---------------------------------------------------------
+    @staticmethod
+    def prefill_compiles() -> int:
+        """Number of distinct prefill XLA programs compiled (== number
+        of length buckets seen across all engines of this process)."""
+        return _paged_prefill._cache_size()
+
+    @staticmethod
+    def decode_compiles() -> int:
+        return _paged_decode_step._cache_size()

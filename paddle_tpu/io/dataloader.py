@@ -311,7 +311,29 @@ class DataLoader:
 
     def _mp_iter(self):
         import multiprocessing as mp
-        ctx = mp.get_context("fork")
+        # prefer forkserver: forking a JAX-initialized (multi-threaded)
+        # parent is deprecated on 3.12+ and can deadlock the child.
+        # forkserver needs a picklable dataset — probe once per loader
+        # (cached across epochs, null-sink pickler so no bytes are
+        # materialized) and fall back to fork for closures/local
+        # classes (documented constraint: fork-path datasets must be
+        # fork-safe instead).
+        method = getattr(self, "_mp_method", None)
+        if method is None:
+            import pickle
+
+            class _NullSink:
+                def write(self, _):
+                    return None
+            try:
+                pickle.Pickler(_NullSink(),
+                               protocol=pickle.HIGHEST_PROTOCOL).dump(
+                    (self.dataset, self.worker_init_fn))
+                method = "forkserver"
+            except Exception:
+                method = "fork"
+            self._mp_method = method
+        ctx = mp.get_context(method)
         batches = list(self.batch_sampler)
         task_q = ctx.Queue()
         res_q = ctx.Queue()

@@ -15,7 +15,21 @@ The returned StaticFunction:
     param updates between calls do NOT trigger recompiles;
   * is differentiable: calling it under the eager tape records ONE
     GradNode whose vjp is the XLA-differentiated whole program, with
-    grads flowing into the Layer's Parameters.
+    grads flowing into the Layer's Parameters;
+  * **graph-breaks like SOT**: with ``full_graph=False`` (the default,
+    matching paddle 3.0), data-dependent python control flow that XLA
+    tracing cannot capture (``if tensor > 0``, ``while tensor...``,
+    ``int(tensor)``) does not error — the call falls back to eager
+    execution, the signature is remembered as a fallback (no re-trace
+    attempts), and the break is logged + counted
+    (``.graph_break_count``).  ``full_graph=True`` keeps the strict
+    contract and re-raises.  Divergence from SOT to know about: SOT
+    splits at the break point and never re-runs the prefix, while this
+    fallback re-executes the WHOLE function eagerly — on the one
+    breaking call, python side effects before the break (prints, list
+    appends) run twice; tensor/layer state is unaffected
+    (functional_state and rng_guard unwind the aborted trace), and
+    subsequent same-signature calls go straight to eager.
 
 Known functional-purity caveat (documented parity gap): BatchNorm
 running-stat mutation inside a to_static region is reverted at trace
@@ -61,14 +75,32 @@ def _is_tensor_leaf(x):
     return isinstance(x, (Tensor, jax.Array, np.ndarray))
 
 
+def _graph_break_errors():
+    """Tracer-concretization error classes — the 'python needs the
+    value, the trace only has a tracer' family that SOT graph-breaks
+    on."""
+    errs = []
+    for name in ("ConcretizationTypeError", "TracerArrayConversionError",
+                 "TracerBoolConversionError",
+                 "TracerIntegerConversionError",
+                 "NonConcreteBooleanIndexError"):
+        cls = getattr(jax.errors, name, None)
+        if cls is not None:
+            errs.append(cls)
+    return tuple(errs)
+
+
 class StaticFunction:
     def __init__(self, function: Callable, input_spec=None,
-                 build_strategy=None, backend=None, full_graph=True,
+                 build_strategy=None, backend=None, full_graph=False,
                  layer: Optional[Layer] = None):
         self._function = function
         self._input_spec = input_spec
         self._layer = layer
         self._cache = {}
+        self._full_graph = full_graph
+        self._fallback_keys = set()
+        self.graph_break_count = 0
         functools.update_wrapper(self, function)
 
     def __get__(self, instance, owner):
@@ -76,6 +108,7 @@ class StaticFunction:
             return self
         return StaticFunction(
             self._function.__get__(instance, owner), self._input_spec,
+            full_graph=self._full_graph,
             layer=instance if isinstance(instance, Layer) else None)
 
     def __call__(self, *args, **kwargs):
@@ -104,6 +137,9 @@ class StaticFunction:
             hash(key)
         except TypeError:
             key = None
+
+        if key is not None and key in self._fallback_keys:
+            return self._function(*args, **kwargs)   # known graph-break
 
         entry = self._cache.get(key) if key is not None else None
         if entry is None:
@@ -146,7 +182,25 @@ class StaticFunction:
         raw.__name__ = getattr(self._function, "__name__", "static_fn")
 
         tensor_arrays = [flat_args[i] for i in tensor_idx]
-        out = apply_op(raw, params_list, tensor_arrays)
+        try:
+            out = apply_op(raw, params_list, tensor_arrays)
+        except _graph_break_errors() as e:
+            if self._full_graph:
+                raise
+            # SOT-style graph break: run this signature eagerly from now
+            # on (the trace attempt left no state — functional_state and
+            # rng_guard unwind on exception)
+            self.graph_break_count += 1
+            if key is not None:
+                self._fallback_keys.add(key)
+                self._cache.pop(key, None)
+            import logging
+            logging.getLogger("paddle_tpu.jit").warning(
+                "to_static graph break in %r (falling back to eager for "
+                "this signature): %s",
+                getattr(self._function, "__name__", "?"),
+                str(e).splitlines()[0] if str(e) else type(e).__name__)
+            return self._function(*args, **kwargs)
         flat_out = list(out) if isinstance(out, (tuple, list)) else [out]
         return jax.tree_util.tree_unflatten(out_tree_box["tree"], flat_out)
 
@@ -156,10 +210,12 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
+              backend=None, full_graph=False, **kwargs):
     """Decorator/wrapper: ``paddle.jit.to_static`` analog.  ``backend`` is
     accepted for parity (CINN in the reference); XLA is always the
-    compiler here."""
+    compiler here.  ``full_graph=False`` (paddle 3.0's default) enables
+    the SOT-style graph-break fallback to eager on data-dependent
+    python control flow; ``True`` raises instead."""
 
     def decorate(fn):
         if isinstance(fn, Layer):

@@ -4,14 +4,20 @@ Reference parity: python/paddle/incubate/distributed/models/moe/
 (MoELayer, gate/ top-k gates with aux load-balance losses) plus the
 phi/kernels/fusion moe dispatch kernels (SURVEY.md §2.3 EP row).
 
-TPU-native design: GShard/Switch dense dispatch — routing produces
-dispatch/combine tensors and the token→expert shuffle is two einsums
-that the XLA SPMD partitioner lowers to all-to-alls over the expert
-axes; expert FFNs are ONE batched matmul over stacked [E, ...] weights
-sharded on the ``(dp, sharding)`` fold (DeepSpeed-MoE style EP=DP
-folding, topology.py get_expert_parallel_group).  No per-expert python
-loop, no NCCL alltoall calls — the reference's MoE runtime collapses
-into sharding annotations.
+TPU-native design, two dispatch paths behind one layer:
+
+- **dense** (GShard/Switch): routing produces dispatch/combine tensors
+  and the token→expert shuffle is two einsums that the XLA SPMD
+  partitioner lowers to all-to-alls over the expert axes; expert FFNs
+  are ONE batched matmul over stacked [E, ...] weights sharded on the
+  ``ep``/(dp, sharding) expert axes.  This is the multi-chip path — the
+  reference's MoE alltoall runtime collapses into sharding annotations.
+- **grouped** (dropless, megablox-class): tokens are sorted by expert
+  into a tile-aligned buffer and the expert FFN runs as Pallas grouped
+  matmuls (ops/pallas/grouped_matmul.py) — no [T, E, C] capacity
+  padding, no dropped tokens, every MXU cycle does useful work.  This
+  is the single-chip / per-shard fast path (the reference's fused phi
+  MoE kernels analog).
 """
 from __future__ import annotations
 
@@ -28,13 +34,14 @@ from .layer import Layer
 
 __all__ = ["TopKGate", "ExpertFFN", "MoELayer", "moe_dispatch_combine"]
 
-EP_AXES = ("dp", "sharding")  # expert dim folds over the data axes
+# expert dim shards over the dedicated ep axis, then folds over the data
+# axes (DeepSpeed-MoE EP=DP folding) for any remaining factor
+EP_AXES = ("ep", "dp", "sharding")
 
 
-def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef):
-    """Router: x [T,H], wg [H,E] -> combine [T,E,C], dispatch [T,E,C],
-    aux_loss (scalar).  Switch-style load-balance + router z-loss."""
-    t = x.shape[0]
+def _router_topk(x, wg, *, k, balance_coef, z_coef):
+    """Shared router math: x [T,H], wg [H,E] -> gate_vals [T,k] (f32,
+    renormalised), expert_idx [T,k] (int32), aux_loss (scalar)."""
     e = wg.shape[1]
     logits = jnp.dot(x.astype(jnp.float32), wg.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)                  # [T, E]
@@ -54,6 +61,16 @@ def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef):
     if z_coef:
         aux = aux + z_coef * jnp.mean(
             jnp.square(jax.scipy.special.logsumexp(logits, axis=-1)))
+    return gate_vals, expert_idx, aux
+
+
+def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef):
+    """Router: x [T,H], wg [H,E] -> combine [T,E,C], dispatch [T,E,C],
+    aux_loss (scalar).  Switch-style load-balance + router z-loss."""
+    t = x.shape[0]
+    e = wg.shape[1]
+    gate_vals, expert_idx, aux = _router_topk(
+        x, wg, k=k, balance_coef=balance_coef, z_coef=z_coef)
 
     # capacity positions: for each (slot, expert) the position within the
     # expert's buffer = number of earlier tokens routed to it
@@ -82,6 +99,20 @@ def moe_dispatch_combine(x, combine, dispatch, expert_fn):
     xe = apply_op(_dispatch_raw, x, dispatch)
     eo = expert_fn(xe)
     return apply_op(_combine_raw, eo, combine)
+
+
+def _moe_grouped_raw(x, router_w, gate_w, up_w, down_w, *, k,
+                     balance_coef, z_coef, tm, interpret):
+    """Fused dropless MoE forward: router + sorted tile-aligned dispatch
+    + Pallas grouped-matmul SwiGLU experts + top-k combine, all inside
+    one raw fn so the integer routing tensors never surface as framework
+    Tensors.  Returns (out [T,H], aux_loss)."""
+    from ..ops.pallas.grouped_matmul import dropless_moe_ffn
+    gate_vals, expert_idx, aux = _router_topk(
+        x, router_w, k=k, balance_coef=balance_coef, z_coef=z_coef)
+    out = dropless_moe_ffn(x, gate_vals, expert_idx, gate_w, up_w,
+                           down_w, tm=tm, interpret=interpret)
+    return out, aux
 
 
 class TopKGate(Layer):
@@ -170,8 +201,15 @@ class MoELayer(Layer):
                  shared_expert_intermediate: int = 0,
                  balance_loss_weight: float = 0.01,
                  init_std: float = 0.02, num_layers_scale: int = 1,
-                 gate: Optional[TopKGate] = None, experts=None):
+                 gate: Optional[TopKGate] = None, experts=None,
+                 dispatch_mode: str = "auto",
+                 group_tile: Optional[int] = None):
         super().__init__()
+        from ..common.errors import enforce
+        enforce(dispatch_mode in ("auto", "dense", "grouped"),
+                f"bad dispatch_mode {dispatch_mode!r}")
+        self.dispatch_mode = dispatch_mode
+        self.group_tile = group_tile
         self.gate = gate or TopKGate(
             hidden_size, num_experts, k=k, capacity_factor=capacity_factor,
             balance_loss_weight=balance_loss_weight)
@@ -195,9 +233,44 @@ class MoELayer(Layer):
             self.shared_gate = None
         self.aux_loss: Optional[Tensor] = None
 
+    def _resolve_dispatch(self) -> str:
+        """'grouped' (dropless Pallas) on a single chip / unsharded
+        experts on TPU; 'dense' (GShard einsums → GSPMD all-to-alls)
+        whenever the expert dim is sharded or off-TPU.  Resolved at
+        trace time — mesh state and backend are static then."""
+        if self.dispatch_mode != "auto":
+            return self.dispatch_mode
+        if not (isinstance(self.gate, TopKGate)
+                and isinstance(self.experts, ExpertFFN)):
+            return "dense"
+        from ..distributed.auto_parallel import get_mesh
+        pm = get_mesh()
+        # any sharding touching the expert weights (expert dim over the
+        # EP axes, F dim over mp) needs the GSPMD-partitionable einsums
+        if pm is not None and any(
+                pm.mesh.shape.get(a, 1) > 1 for a in EP_AXES + ("mp",)):
+            return "dense"
+        import jax as _jax
+        return "grouped" if _jax.default_backend() == "tpu" else "dense"
+
     def forward(self, x):
         b, s, h = x.shape
         flat = apply_op(lambda a: a.reshape(b * s, h), x)
+        mode = self._resolve_dispatch()
+        if mode == "grouped":
+            out, aux = apply_op(
+                _moe_grouped_raw, flat, self.gate.weight,
+                self.experts.gate_w, self.experts.up_w,
+                self.experts.down_w, k=self.gate.k,
+                balance_coef=self.gate.balance_loss_weight,
+                z_coef=self.gate.z_loss_weight, tm=self.group_tile,
+                interpret=jax.default_backend() != "tpu")
+            self.aux_loss = aux
+            if self.shared_gate is not None:
+                from . import functional as F_
+                out = out + self.shared_down(
+                    F_.silu(self.shared_gate(flat)) * self.shared_up(flat))
+            return apply_op(lambda a: a.reshape(b, s, h), out)
         combine, dispatch, aux = self.gate(flat)
         self.aux_loss = aux
         out = moe_dispatch_combine(flat, combine, dispatch, self.experts)

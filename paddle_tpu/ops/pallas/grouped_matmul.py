@@ -1,0 +1,281 @@
+"""Grouped (per-expert) matmul for TPU — the MoE expert-compute kernel.
+
+Reference parity: phi/kernels/fusion moe grouped-GEMM kernels (the
+reference's fused expert FFN path, SURVEY.md §2.3 EP row).
+
+TPU-native design (megablox-class, built independently): tokens are
+pre-sorted by expert and padded so every ``tm``-row tile belongs to
+exactly ONE expert; a scalar-prefetched ``tile_expert`` map then lets
+each grid step DMA the right expert's weight block, so the whole MoE
+FFN is dense MXU matmuls over the ragged token groups — no [T, E, C]
+capacity-padded dispatch tensors, no wasted FLOPs on empty capacity
+slots, and dropless routing (no token dropping) for free.
+
+Three kernels:
+- ``_gmm_kernel``      out[i] = lhs[i] @ w[e(i)]      (fwd, and dX with
+                       ``transpose_w`` contracting w's last dim)
+- ``_gmm_dw_kernel``   dw[e] += lhs[i].T @ dout[i]    (weight grad; the
+                       m grid dim is innermost so each (e, k, n) output
+                       block is visited in one contiguous run)
+
+The public entry :func:`grouped_matmul` wires these into a
+``jax.custom_vjp``; :func:`make_dropless_plan` builds the sorted,
+tile-aligned token layout from router top-k indices (all jit-safe,
+static shapes).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["grouped_matmul", "gmm_reference", "make_dropless_plan",
+           "dropless_moe_ffn"]
+
+
+def _pick_tile(dim: int, cap: int) -> int:
+    """Largest divisor of ``dim`` that is <= cap AND a multiple of 128
+    (Mosaic lane constraint for minor block dims); falls back to the
+    full dim (always legal) when no such divisor exists, e.g. 704."""
+    t = (min(cap, dim) // 128) * 128
+    while t >= 128:
+        if dim % t == 0:
+            return t
+        t -= 128
+    return dim
+
+
+# ---------------------------------------------------------------------------
+# out[i] = lhs[i] @ w[e(i)]    (and the dX variant via transpose_w)
+# ---------------------------------------------------------------------------
+
+def _gmm_kernel(te_ref, lhs_ref, w_ref, out_ref, acc_ref, *, nc,
+                transpose_w):
+    ic = pl.program_id(2)
+
+    @pl.when(ic == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    a = lhs_ref[...].astype(jnp.float32)                   # [tm, tc]
+    b = w_ref[0].astype(jnp.float32)                       # [tc,tj]|[tj,tc]
+    dims = (((1,), (1,)), ((), ())) if transpose_w \
+        else (((1,), (0,)), ((), ()))
+    acc_ref[...] += jax.lax.dot_general(
+        a, b, dims, preferred_element_type=jnp.float32)
+
+    @pl.when(ic == nc - 1)
+    def _():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _gmm_call(lhs, w, tile_expert, *, transpose_w, tm, tc, tj,
+              interpret=False):
+    m, _ = lhs.shape
+    if transpose_w:      # w [E, J, C], contract C
+        j_dim = w.shape[1]
+        w_block = (1, tj, tc)
+        w_imap = lambda i, j, c, te: (te[i], j, c)
+    else:                # w [E, C, J]
+        j_dim = w.shape[2]
+        w_block = (1, tc, tj)
+        w_imap = lambda i, j, c, te: (te[i], c, j)
+    nm, nj, nc = m // tm, j_dim // tj, lhs.shape[1] // tc
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, nc=nc, transpose_w=transpose_w),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nm, nj, nc),
+            in_specs=[
+                pl.BlockSpec((tm, tc), lambda i, j, c, te: (i, c)),
+                pl.BlockSpec(w_block, w_imap),
+            ],
+            out_specs=pl.BlockSpec((tm, tj), lambda i, j, c, te: (i, j)),
+            scratch_shapes=[pltpu.VMEM((tm, tj), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, j_dim), lhs.dtype),
+        interpret=interpret,
+    )(tile_expert.astype(jnp.int32), lhs, w)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dw[e] = sum over e's tiles of lhs[i].T @ dout[i]
+# ---------------------------------------------------------------------------
+
+def _gmm_dw_kernel(te_ref, lhs_ref, dout_ref, dw_ref, acc_ref, *, nm):
+    i = pl.program_id(2)
+    e = te_ref[i]
+    first = jnp.logical_or(i == 0, te_ref[jnp.maximum(i - 1, 0)] != e)
+    last = jnp.logical_or(i == nm - 1,
+                          te_ref[jnp.minimum(i + 1, nm - 1)] != e)
+
+    @pl.when(first)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    a = lhs_ref[...].astype(jnp.float32)                    # [tm, tk]
+    g = dout_ref[...].astype(jnp.float32)                   # [tm, tn]
+    acc_ref[...] += jax.lax.dot_general(
+        a, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [tk, tn]
+
+    @pl.when(last)
+    def _():
+        dw_ref[0] = acc_ref[...].astype(dw_ref.dtype)
+
+
+def _gmm_dw_call(lhs, dout, tile_expert, counts, num_experts, *, tm, tk,
+                 tn, interpret=False):
+    m, k = lhs.shape
+    n = dout.shape[1]
+    nm, nk, nn = m // tm, k // tk, n // tn
+    dw = pl.pallas_call(
+        functools.partial(_gmm_dw_kernel, nm=nm),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # m innermost: each (e, kk, j) output block is one contiguous
+            # visit run, zero-initialised on the run's first tile
+            grid=(nk, nn, nm),
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda kk, j, i, te: (i, kk)),
+                pl.BlockSpec((tm, tn), lambda kk, j, i, te: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, tk, tn),
+                                   lambda kk, j, i, te: (te[i], kk, j)),
+            scratch_shapes=[pltpu.VMEM((tk, tn), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((num_experts, k, n), lhs.dtype),
+        interpret=interpret,
+    )(tile_expert.astype(jnp.int32), lhs, dout)
+    # experts with zero tiles were never visited — their blocks are
+    # uninitialised memory, not zeros
+    return jnp.where((counts > 0)[:, None, None], dw,
+                     jnp.zeros_like(dw))
+
+
+# ---------------------------------------------------------------------------
+# public custom-vjp entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def grouped_matmul(lhs, w, tile_expert, counts, cfg):
+    """lhs [M, K] @ w[tile_expert[i]] -> [M, N], rows pre-grouped so each
+    tm-row tile maps to one expert.  ``cfg`` = (tm, tk, tn, interpret)."""
+    tm, tk, tn, interp = cfg
+    return _gmm_call(lhs, w, tile_expert, transpose_w=False, tm=tm,
+                     tc=tk, tj=tn, interpret=interp)
+
+
+def _grouped_matmul_fwd(lhs, w, tile_expert, counts, cfg):
+    return grouped_matmul(lhs, w, tile_expert, counts, cfg), \
+        (lhs, w, tile_expert, counts)
+
+
+def _grouped_matmul_bwd(cfg, res, dout):
+    lhs, w, tile_expert, counts = res
+    tm, tk, tn, interp = cfg
+    dlhs = _gmm_call(dout, w, tile_expert, transpose_w=True, tm=tm,
+                     tc=tn, tj=tk, interpret=interp)
+    dw = _gmm_dw_call(lhs, dout, tile_expert, counts, w.shape[0],
+                      tm=tm, tk=tk, tn=tn, interpret=interp)
+    return dlhs.astype(lhs.dtype), dw.astype(w.dtype), None, None
+
+
+grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)
+
+
+def gmm(lhs, w, tile_expert, counts, *, tm=512, interpret=False):
+    """Convenience wrapper picking legal tile sizes for [M,K]@[E,K,N].
+
+    Measured on v5e (36864×1024 @ 8×1024×704, bf16): tm=512 with the
+    full K as one block beats tm=256/tk=512 by ~1.5× and beats XLA's
+    dense batched einsum by ~1.36× (26.9 vs 19.8 TFLOP/s in a
+    serialized scan microbench)."""
+    k, n = w.shape[1], w.shape[2]
+    cfg = (tm, _pick_tile(k, 1024), _pick_tile(n, 1024), interpret)
+    return grouped_matmul(lhs, w, tile_expert, counts, cfg)
+
+
+def gmm_reference(lhs, w, tile_expert, counts=None, *, tm=128):
+    """Pure-jnp oracle: per-row expert gather then row-wise matmul."""
+    row_expert = jnp.repeat(tile_expert, tm)               # [M]
+    wr = w[row_expert]                                     # [M, K, N]
+    return jnp.einsum("mk,mkn->mn", lhs.astype(jnp.float32),
+                      wr.astype(jnp.float32)).astype(lhs.dtype)
+
+
+# ---------------------------------------------------------------------------
+# dropless layout: sorted-by-expert, tile-aligned
+# ---------------------------------------------------------------------------
+
+def make_dropless_plan(expert_idx, num_experts: int, tm: int):
+    """From router top-k ``expert_idx`` [T, k] build the tile-aligned
+    sorted layout (all static shapes, jit-safe):
+
+    - ``order``   [T*k]  slot ids sorted by expert (stable)
+    - ``dest``    [T*k]  destination row of sorted slot i in the padded
+                         buffer (each expert starts at a tm boundary)
+    - ``tile_expert`` [M//tm] expert owning each row tile
+    - ``counts``  [E]    tokens routed to each expert
+    - ``m_pad``   int    static padded row count
+    """
+    t, k = expert_idx.shape
+    s = t * k
+    flat = expert_idx.reshape(s)
+    order = jnp.argsort(flat, stable=True)
+    sorted_e = flat[order]
+    counts = jnp.bincount(flat, length=num_experts)
+    padded = ((counts + tm - 1) // tm) * tm
+    pad_start = jnp.concatenate(
+        [jnp.zeros(1, padded.dtype), jnp.cumsum(padded)[:-1]])
+    start = jnp.concatenate(
+        [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(s) - start[sorted_e]
+    dest = pad_start[sorted_e] + rank                      # [T*k]
+
+    m_pad = -(-s // tm) * tm + num_experts * tm            # static bound
+    tile_start = jnp.arange(m_pad // tm) * tm
+    # expert owning tile = last e with pad_start[e] <= tile_start
+    tile_expert = jnp.searchsorted(pad_start, tile_start,
+                                   side="right") - 1
+    tile_expert = jnp.clip(tile_expert, 0, num_experts - 1)
+    return order, dest, tile_expert, counts, m_pad
+
+
+def dropless_moe_ffn(x, gate_vals, expert_idx, wg, wu, wd, *, tm=None,
+                     interpret=False, act=jax.nn.silu):
+    """Full dropless MoE FFN: route x [T, H] through per-expert SwiGLU
+    experts (wg/wu [E, H, F], wd [E, F, H]) with top-k combine weights
+    gate_vals [T, k] — three grouped matmuls on the sorted layout.
+
+    ``tm=None`` picks the row tile adaptively: as large as possible
+    (512 is fastest on v5e) while keeping the per-expert tile padding
+    under ~25% of the slot count (matters at 60+ experts)."""
+    t, h = x.shape
+    k = expert_idx.shape[1]
+    e = wg.shape[0]
+    if tm is None:
+        tm = 128
+        while tm < 512 and e * (tm * 2) * 4 <= t * k:
+            tm *= 2
+    order, dest, tile_expert, counts, m_pad = make_dropless_plan(
+        expert_idx, e, tm)
+    # scatter token rows into the padded sorted buffer (dup per slot)
+    rows = x[order // k]                                   # [T*k, H]
+    xs = jnp.zeros((m_pad, h), x.dtype).at[dest].set(rows)
+
+    hg = gmm(xs, wg, tile_expert, counts, tm=tm, interpret=interpret)
+    hu = gmm(xs, wu, tile_expert, counts, tm=tm, interpret=interpret)
+    hs = (act(hg.astype(jnp.float32)) *
+          hu.astype(jnp.float32)).astype(x.dtype)
+    ys = gmm(hs, wd, tile_expert, counts, tm=tm, interpret=interpret)
+
+    y_slots = ys[dest]                                     # [T*k, H] sorted
+    y = jnp.zeros((t * k, h), ys.dtype).at[order].set(y_slots)
+    out = jnp.einsum("tk,tkh->th", gate_vals.astype(jnp.float32),
+                     y.reshape(t, k, h).astype(jnp.float32))
+    return out.astype(x.dtype)

@@ -28,7 +28,7 @@ class TestTopology:
     def test_mesh_axes_and_sizes(self):
         hcg = fleet.init(strategy=make_strategy(dp=2, mp=2, sharding=2))
         assert hcg.mesh.shape == {"pp": 1, "dp": 2, "sharding": 2,
-                                  "sep": 1, "mp": 2}
+                                  "ep": 1, "sep": 1, "mp": 2}
         assert hcg.get_model_parallel_world_size() == 2
         assert hcg.get_data_parallel_group().nranks == 2
 

@@ -163,3 +163,80 @@ class TestJitSaveLoad:
         x = paddle.ops.randn([2, 4])
         np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestGraphBreakFallback:
+    """SOT-contract parity (SURVEY §2.2 jit row): data-dependent python
+    control flow graph-breaks to eager instead of erroring."""
+
+    def test_data_dependent_if_falls_back(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            s = (x * x).sum()
+            if float(s.numpy()) > 0:        # needs a concrete value
+                calls.append("pos")
+                return s * 2
+            return s
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        out = f(x)
+        assert float(out.numpy()) == 8.0
+        assert f.graph_break_count == 1
+        # same signature: no second trace attempt, straight to eager
+        out2 = f(x)
+        assert float(out2.numpy()) == 8.0
+        assert f.graph_break_count == 1
+
+    def test_data_dependent_while_falls_back(self):
+        @paddle.jit.to_static
+        def f(x):
+            n = 0
+            while float(x.sum().numpy()) < 10:
+                x = x + 1
+                n += 1
+            return x, n
+
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        out, n = f(x)
+        assert n == 5
+        assert f.graph_break_count == 1
+
+    def test_full_graph_true_raises(self):
+        @paddle.jit.to_static(full_graph=True)
+        def f(x):
+            if float(x.sum().numpy()) > 0:
+                return x * 2
+            return x
+
+        with pytest.raises(Exception):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+    def test_traceable_code_still_compiles(self):
+        @paddle.jit.to_static
+        def f(x):
+            return (x * 3).sum()
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        assert float(f(x).numpy()) == 9.0
+        assert f.graph_break_count == 0
+        assert len(f._cache) == 1
+
+    def test_gradients_flow_through_fallback(self):
+        lin = paddle.nn.Linear(4, 2)
+
+        def fwd(m, x):
+            y = m(x)
+            if float(y.sum().numpy()) > -1e30:   # always true, breaks
+                return (y * y).sum()
+            return y.sum()
+
+        sf = paddle.jit.to_static(lambda x: fwd(lin, x))
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        loss = sf(x)
+        loss.backward()
+        assert sf.graph_break_count == 1
+        g = lin.weight.grad
+        assert g is not None
+        assert np.isfinite(np.asarray(g.numpy())).all()
