@@ -11,10 +11,22 @@ pipeline inside ``jax.shard_map`` manual over ONLY the ``pp`` mesh axis
 (dp/sharding/mp stay auto, so GSPMD still lays out data/tensor/FSDP
 parallelism inside each stage).  Stage params are stacked on a leading
 axis sharded over ``pp``; activations rotate between stages with
-``lax.ppermute`` over ICI; backward is derived by jax.grad through the
-loop (GPipe schedule: all-forward then reversed all-backward, remat per
-stage via jax.checkpoint).  Bubble fraction = (S-1)/(M+S-1), same as
-1F1B; 1F1B's memory advantage is recovered with stage remat instead.
+``lax.ppermute`` over ICI.
+
+Two backward strategies:
+
+* ``pipeline_train_1f1b`` (training default, n_virtual==1): a TRUE
+  1F1B schedule — ONE fused loop interleaves each microbatch's
+  backward with the forwards (B_s(m) fires at tick m + 2S-1-s, F_s(m)
+  at m + s), holding stage inputs in a ring buffer of 2S slots.  Peak
+  live activation memory is bounded by the in-flight microbatch count
+  (∝ pp), NOT by n_micro — the reference 1F1B's memory bound
+  (fleet PipelineParallel.train_batch), delivered as a jax.custom_vjp
+  whose backward replays nothing: grads are accumulated inside the
+  same loop via per-tick jax.vjp at the saved stage inputs.
+* ``gpipe_spmd`` + jax.grad (eval / interleaved v>1): backward derived
+  by AD through the loop (all-forward-then-all-backward), with stage
+  remat; residual memory ∝ n_micro.
 """
 from __future__ import annotations
 
@@ -30,7 +42,8 @@ from ..common.errors import enforce
 from ..nn.layer import Layer
 from ..nn.container import LayerList
 
-__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "gpipe_spmd"]
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "gpipe_spmd",
+           "pipeline_train_1f1b"]
 
 
 # ---------------------------------------------------------------------------
@@ -38,16 +51,30 @@ __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "gpipe_spmd"]
 # ---------------------------------------------------------------------------
 
 def _pvary(x, axis):
+    # no-op when already varying over this axis (pcast rejects that);
+    # any OTHER ValueError (bad axis name etc.) must surface here, not
+    # as an opaque vma mismatch deep in the scan
+    aval = getattr(jax, "typeof", jax.core.get_aval)(x)
+    if axis in getattr(aval, "vma", ()):
+        return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis,), to="varying")
     return jax.lax.pvary(x, (axis,))
+
+
+def _mesh_platform(mesh) -> str:
+    try:
+        return list(mesh.devices.flat)[0].platform
+    except Exception:
+        return "cpu"
 
 
 @functools.lru_cache(maxsize=64)
 def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
                      n_params: int, n_extra: int, remat: bool,
                      n_virtual: int, tail_fn: Optional[Callable] = None,
-                     n_tail_params: int = 0, n_tail_idx: int = 0):
+                     n_tail_params: int = 0, n_tail_idx: int = 0,
+                     tail_cond: Optional[bool] = None):
     """Build + cache the jitted shard_map engine (keyed on a *stable*
     stage_fn object so eager loops don't re-trace every step).
 
@@ -74,6 +101,11 @@ def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     tfn = (jax.checkpoint(tail_fn) if (remat and tail_fn is not None)
            else tail_fn)
+    # cond-guard the loss tail on TPU; XLA:CPU keeps the masked path
+    # (grad-of-cond-in-scan aborts there, jax 0.9).  Callers that never
+    # differentiate through the loop (the 1F1B primal) force it on.
+    if tail_cond is None:
+        tail_cond = _mesh_platform(mesh) == "tpu"
 
     def inner(params_local, xm, *rest):
         extra_local = rest[:n_extra]
@@ -121,13 +153,25 @@ def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
             if tfn is None:
                 acc = jax.lax.dynamic_update_index_in_dim(
                     acc, jnp.where(keep, y, acc[mc]), mc, 0)
+            elif tail_cond:
+                # TPU path: lax.cond skips the dead tail evaluations
+                # (norm + lm-head matmul over the full vocab!) on every
+                # stage/tick where keep is False — the round-2 "loss
+                # tail runs on every stage every tick" waste
+                tout = jax.lax.cond(
+                    keep,
+                    lambda: jax.tree_util.tree_map(
+                        lambda o: _pvary(o, pp_axis),
+                        tfn(tail_local, y, *(ti[mc] for ti in
+                                             tail_idx))),
+                    lambda: jax.tree_util.tree_map(
+                        lambda a: jnp.zeros_like(a), acc))
+                acc = jax.tree_util.tree_map(lambda a, o: a + o, acc,
+                                             tout)
             else:
-                # the tail runs every tick on every stage and is masked
-                # (SPMD lockstep).  A lax.cond would skip the dead
-                # evaluations, but grad-of-cond inside scan inside
-                # shard_map aborts XLA:CPU (jax 0.9) — and the masked
-                # work rides ticks where non-final stages would
-                # otherwise idle at the next ppermute barrier anyway.
+                # XLA:CPU fallback: the tail runs every tick on every
+                # stage and is masked (SPMD lockstep) — grad-of-cond
+                # inside scan inside shard_map aborts XLA:CPU (jax 0.9)
                 tout = tfn(tail_local, y, *(ti[mc] for ti in tail_idx))
                 acc = jax.tree_util.tree_map(
                     lambda a, o: a + jnp.where(keep, o, jnp.zeros_like(o)),
@@ -158,7 +202,8 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
                mesh, pp_axis: str = "pp", remat: bool = True,
                n_virtual: int = 1, tail_fn: Optional[Callable] = None,
                tail_params: Sequence[jax.Array] = (),
-               tail_indexed: Sequence[jax.Array] = ()):
+               tail_indexed: Sequence[jax.Array] = (),
+               tail_cond: Optional[bool] = None):
     """Run ``stage_fn`` as a circulating SPMD pipeline.
 
     params:   arrays stacked [n_chunks, ...] in global chunk order,
@@ -196,11 +241,247 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
         stacked.append(jnp.swapaxes(q, 0, 1))
     fn = _jitted_pipeline(stage_fn, mesh, pp_axis, len(params),
                           len(extra), remat, n_virtual, tail_fn,
-                          len(tail_params), len(tail_indexed))
+                          len(tail_params), len(tail_indexed),
+                          tail_cond)
     out = fn(tuple(stacked), x_micro, *extra, *tail_params, *tail_indexed)
     if tail_fn is not None:
         return out
     return out[nstage - 1]                   # last stage's buffer
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: fused forward+backward schedule (training path, n_virtual == 1)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
+                 pp_axis: str, n_params: int, n_extra: int,
+                 n_tail_params: int, n_tail_idx: int):
+    """The fused 1F1B loop (fleet PipelineParallel.train_batch's
+    schedule, compiled): at tick t, stage s runs forward on microbatch
+    ``t - s`` and backward on microbatch ``t - (2S-1) + s``.  Stage
+    inputs wait in a ring buffer of 2S slots (max in-flight is 2S-1 at
+    stage 0), so peak activation memory is ∝ S in-flight microbatches
+    — independent of n_micro.  Gradients come from per-tick jax.vjp at
+    the saved inputs (no AD through the loop, so lax.cond may skip
+    inactive ramp ticks and the per-stage branch on every backend).
+
+    Returns (loss_sum, count, grads_stacked, dxm, grads_tail) with the
+    grads UNSCALED (cotangent 1.0 on loss_sum); the custom_vjp wrapper
+    scales by the incoming cotangent and 1/count.
+    """
+    nstage = mesh.shape[pp_axis]
+    # XLA:CPU aborts on lax.cond inside a loop inside shard_map (jax
+    # 0.9) — fall back to computing both branches + select there; TPU
+    # gets real conds (ramp ticks and the last-stage branch cost ~0)
+    use_cond = _mesh_platform(mesh) == "tpu"
+
+    def _branch(pred, true_fn, false_fn, operand):
+        if use_cond:
+            return jax.lax.cond(pred, true_fn, false_fn, operand)
+        t = true_fn(operand)
+        f = false_fn(operand)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(pred, a, b), t, f)
+
+    def inner(params_local, xm, *rest):
+        extra = rest[:n_extra]
+        tail_params = rest[n_extra:n_extra + n_tail_params]
+        tail_idx = rest[n_extra + n_tail_params:]
+        locals_ = [p[0] for p in params_local]      # [per_chunk, ...]
+        n_micro = xm.shape[0]
+        stage = jax.lax.axis_index(pp_axis)
+        s_count = nstage
+        ring_n = 2 * s_count
+        total = n_micro + 2 * s_count - 1
+        is_last = stage == s_count - 1
+
+        def fwd_fn(chunk, inp):
+            return stage_fn(chunk, inp, *extra)
+
+        def last_fn(chunk, inp, tailp, lbls):
+            return tail_fn(tailp, stage_fn(chunk, inp, *extra), *lbls)
+
+        act = jax.eval_shape(lambda x: x[0], xm)
+        zero_act = _pvary(jnp.zeros(act.shape, act.dtype), pp_axis)
+        xmv = _pvary(xm, pp_axis)
+        tail_idx_v = tuple(_pvary(t, pp_axis) for t in tail_idx)
+        # tail params must be VARYING here: a vjp wrt a replicated
+        # (unvaried) input makes jax transpose-insert a psum over pp on
+        # its cotangent at every tick — wrong (it mixes the other
+        # stages' masked-out branch values) and a collective per tick.
+        # Varying inputs keep cotangents device-local; the single psum
+        # at the end does the cross-stage reduction.
+        tail_params = tuple(_pvary(t, pp_axis) for t in tail_params)
+        state = (
+            zero_act,                                        # fwd carry
+            zero_act,                                        # bwd carry
+            _pvary(jnp.zeros((ring_n,) + act.shape, act.dtype), pp_axis),
+            tuple(_pvary(jnp.zeros(c.shape, jnp.float32), pp_axis)
+                  for c in locals_),                         # param grads
+            tuple(_pvary(jnp.zeros(t.shape, jnp.float32), pp_axis)
+                  for t in tail_params),                     # tail grads
+            _pvary(jnp.zeros(xm.shape, jnp.float32), pp_axis),  # dxm
+            _pvary(jnp.zeros((), jnp.float32), pp_axis),     # loss sum
+            _pvary(jnp.zeros((), jnp.float32), pp_axis),     # count
+        )
+
+        def step(t, st):
+            fcarry, bcarry, ring, gp, gt, dxm, lsum, cnt = st
+
+            # ---- forward: F_s(m) at t = m + s --------------------------
+            mf = t - stage
+            active_f = (mf >= 0) & (mf < n_micro)
+            mfc = jnp.clip(mf, 0, n_micro - 1)
+            inp = jnp.where(stage == 0, xmv[mfc], fcarry)
+
+            def do_f(ring):
+                y = fwd_fn(locals_, inp)
+                ring = jax.lax.dynamic_update_index_in_dim(
+                    ring, inp, mfc % ring_n, 0)
+                return y, ring
+
+            y, ring = _branch(
+                active_f, do_f, lambda ring: (inp, ring), ring)
+
+            # ---- backward: B_s(m) at t = m + 2S-1-s --------------------
+            mb = t - (2 * s_count - 1) + stage
+            active_b = (mb >= 0) & (mb < n_micro)
+            mbc = jnp.clip(mb, 0, n_micro - 1)
+            sinp = ring[mbc % ring_n]
+
+            def bwd_last(_):
+                lbls = tuple(ti[mbc] for ti in tail_idx_v)
+                (s_, c_), vjp = jax.vjp(
+                    lambda ch, ip, tp: last_fn(ch, ip, tp, lbls),
+                    locals_, sinp, tuple(tail_params))
+                def seed(p, fill):
+                    ct = jnp.full(p.shape, fill, p.dtype)
+                    aval = getattr(jax, "typeof", jax.core.get_aval)(p)
+                    if pp_axis in getattr(aval, "vma", ()):
+                        ct = _pvary(ct, pp_axis)
+                    return ct
+                dch, dip, dtp = vjp((seed(s_, 1.0), seed(c_, 0.0)))
+                # cotangents of replicated (unvaried) inputs come back
+                # unvaried — align vma/pytree with the other branches
+                dch = tuple(_pvary(g, pp_axis) for g in dch)
+                dip = _pvary(dip, pp_axis)
+                dtp = tuple(_pvary(g, pp_axis) for g in dtp)
+                return (dch, dip, dtp,
+                        _pvary(s_.astype(jnp.float32), pp_axis),
+                        _pvary(c_.astype(jnp.float32), pp_axis))
+
+            def bwd_mid(_):
+                _, vjp = jax.vjp(
+                    lambda ch, ip: fwd_fn(ch, ip), locals_, sinp)
+                dch, dip = vjp(bcarry)
+                dch = tuple(_pvary(g, pp_axis) for g in dch)
+                zt = tuple(_pvary(jnp.zeros(t.shape, t.dtype), pp_axis)
+                           for t in tail_params)
+                z = _pvary(jnp.zeros((), jnp.float32), pp_axis)
+                return dch, _pvary(dip, pp_axis), zt, z, z
+
+            def do_b(_):
+                return _branch(is_last, bwd_last, bwd_mid, None)
+
+            def skip_b(_):
+                zc = tuple(_pvary(jnp.zeros(c.shape, c.dtype), pp_axis)
+                           for c in locals_)
+                zt = tuple(_pvary(jnp.zeros(t.shape, t.dtype), pp_axis)
+                           for t in tail_params)
+                z = _pvary(jnp.zeros((), jnp.float32), pp_axis)
+                return zc, zero_act, zt, z, z
+
+            dch, dip, dtp, ds, dc = _branch(active_b, do_b, skip_b,
+                                            None)
+            gp = tuple(g + d.astype(jnp.float32)
+                       for g, d in zip(gp, dch))
+            gt = tuple(g + d.astype(jnp.float32)
+                       for g, d in zip(gt, dtp))
+            lsum = lsum + ds
+            cnt = cnt + dc
+            # stage 0's dinp is the cotangent of this microbatch's input
+            dxm = jnp.where(
+                active_b & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dxm, dip.astype(jnp.float32), mbc, 0),
+                dxm)
+
+            # ---- rotate: y forward, dinp backward ----------------------
+            fcarry = jax.lax.ppermute(
+                y, pp_axis,
+                [(i, (i + 1) % s_count) for i in range(s_count)])
+            bcarry = jax.lax.ppermute(
+                dip.astype(act.dtype), pp_axis,
+                [(i, (i - 1) % s_count) for i in range(s_count)])
+            return fcarry, bcarry, ring, gp, gt, dxm, lsum, cnt
+
+        _, _, _, gp, gt, dxm, lsum, cnt = jax.lax.fori_loop(
+            0, total, step, state)
+        lsum = jax.lax.psum(lsum, pp_axis)
+        cnt = jax.lax.psum(cnt, pp_axis)
+        dxm = jax.lax.psum(dxm, pp_axis)          # stage 0 contributed
+        gt = tuple(jax.lax.psum(g, pp_axis) for g in gt)   # last stage
+        gp = tuple(g[None] for g in gp)           # [1, per, ...]
+        return lsum, cnt, gp, dxm, gt
+
+    in_specs = (tuple(P(pp_axis) for _ in range(n_params)), P(),
+                *(P() for _ in range(n_extra + n_tail_params
+                                     + n_tail_idx)))
+    out_specs = (P(), P(), tuple(P(pp_axis) for _ in range(n_params)),
+                 P(), tuple(P() for _ in range(n_tail_params)))
+    mapped = jax.shard_map(inner, mesh=mesh, axis_names={pp_axis},
+                           in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(mapped)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
+                        x_micro, extra, tail_params, tail_indexed):
+    """Mean loss of the pipelined model+loss-head under the 1F1B
+    schedule.  ``tail_fn`` must return ``(loss_sum, valid_count)``; the
+    result is Σloss_sum / max(Σcount, 1) over all microbatches.
+
+    Differentiable via custom_vjp: under jax.grad the fwd rule runs the
+    fused 1F1B loop ONCE, producing loss and all gradients together
+    (stage-input ring buffer ⇒ activation memory ∝ pp, not n_micro);
+    without grad, the plain forward pipeline runs (cond-guarded tail).
+    stacked: tuple of [S, per_chunk, ...] arrays (global chunk order,
+    n_virtual==1)."""
+    loss_sum, count = gpipe_spmd(
+        list(stacked), x_micro, stage_fn, *extra, mesh=mesh,
+        pp_axis=pp_axis, n_virtual=1, tail_fn=tail_fn,
+        tail_params=tuple(tail_params),
+        tail_indexed=tuple(tail_indexed), tail_cond=True)
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
+def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
+                     extra, tail_params, tail_indexed):
+    eng = _jitted_1f1b(stage_fn, tail_fn, mesh, pp_axis, len(stacked),
+                       len(extra), len(tail_params), len(tail_indexed))
+    lsum, cnt, gp, dxm, gt = eng(tuple(stacked), x_micro, *extra,
+                                 *tail_params, *tail_indexed)
+    denom = jnp.maximum(cnt, 1.0)
+    loss = lsum / denom
+    # cotangents must come back in the primal dtypes; scale-by-ct in
+    # the bwd rule preserves each grad's dtype
+    gp = tuple(g.astype(p.dtype) for g, p in zip(gp, stacked))
+    dxm = dxm.astype(x_micro.dtype)
+    gt = tuple(g.astype(t.dtype) for g, t in zip(gt, tail_params))
+    return loss, (gp, dxm, gt, denom)
+
+
+def _ptrain_1f1b_bwd(stage_fn, tail_fn, mesh, pp_axis, res, ct):
+    gp, dxm, gt, denom = res
+    scale = ct / denom
+    dstacked = tuple((g * scale).astype(g.dtype) for g in gp)
+    dx = (dxm * scale).astype(dxm.dtype)
+    dtail = tuple((g * scale).astype(g.dtype) for g in gt)
+    return dstacked, dx, None, dtail, None
+
+
+pipeline_train_1f1b.defvjp(_ptrain_1f1b_fwd, _ptrain_1f1b_bwd)
 
 
 # ---------------------------------------------------------------------------

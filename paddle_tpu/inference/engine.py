@@ -137,20 +137,26 @@ def _paged_prefill(stack, norm_w, head_w, embed_w, rope, ids, last_idx,
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
-                     "strategy", "top_k", "top_p", "temperature"),
+                     "strategy", "top_k", "top_p", "temperature",
+                     "n_steps"),
     donate_argnames=("k_pages", "v_pages"))
 def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                        k_pages, v_pages, tokens, positions, tables, lens,
                        key, *, eps: float, kvh: int, head_dim: int,
                        transpose_head: bool = False,
                        strategy: str = "greedy_search", top_k: int = 0,
-                       top_p: float = 1.0, temperature: float = 1.0):
-    """One decode token for every active sequence.
+                       top_p: float = 1.0, temperature: float = 1.0,
+                       n_steps: int = 1):
+    """``n_steps`` decode tokens for every active sequence as ONE XLA
+    program (multi-step scheduling: the host syncs — EOS checks,
+    admission — every n_steps tokens, so dispatch latency amortizes
+    over n_steps; page capacity for all n_steps is pre-allocated by the
+    caller).
 
     stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
     order); k/v_pages [L, KVH, n_pages, P, D]; tokens [B] int32;
     positions [B] (= current lengths); tables [B, maxp]; lens [B].
-    Returns (next_tokens [B], k_pages', v_pages').
+    Returns (tokens [n_steps, B], k_pages', v_pages').
     """
     import jax
     import jax.numpy as jnp
@@ -163,45 +169,62 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
 
     cos_t, sin_t = rope                       # [maxpos, D]
     b = tokens.shape[0]
-    h = embed_w.shape[1]
-    x = jnp.take(embed_w, tokens, axis=0)     # [B, H]
-
-    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [B, 1, D]
-    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
 
     from ..models.llama import _rotate_half as rotate_half
+    from ..nn.generation import sample_logits
 
     attend = paged_attention_raw if is_compiled_with_tpu() \
         else paged_attention_reference
 
-    def layer(carry, xs):
-        hcur = carry
-        lp, kp, vp = xs                        # per-layer params + pools
-        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
-        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
-        nh = qw.shape[1] // head_dim
-        q = jnp.matmul(hn, qw).reshape(b, nh, head_dim)
-        k = jnp.matmul(hn, kw).reshape(b, kvh, head_dim)
-        v = jnp.matmul(hn, vw).reshape(b, kvh, head_dim)
-        qf = q.astype(jnp.float32)
-        kf = k.astype(jnp.float32)
-        q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
-        k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
-        kp, vp = paged_write(kp, vp, k, v, tables, lens)
-        attn = attend(q, kp, vp, tables, lens + 1)     # incl. new token
-        hcur = hcur + jnp.matmul(attn.reshape(b, nh * head_dim), ow)
-        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
-        return hcur + jnp.matmul(ff, dw), (kp, vp)
+    def one_token(carry):
+        tokens, positions, lens, k_pages, v_pages, key = carry
+        x = jnp.take(embed_w, tokens, axis=0)  # [B, H]
+        cos = jnp.take(cos_t, positions, axis=0)[:, None, :]  # [B,1,D]
+        sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer, x, (tuple(stack), k_pages, v_pages))
-    x = _nn.rms_norm(x, norm_w, epsilon=eps)
-    logits = jnp.matmul(x, head_w.T if transpose_head else head_w)
-    from ..nn.generation import sample_logits
-    nxt, _ = sample_logits(logits, key, strategy=strategy, top_k=top_k,
-                           top_p=top_p, temperature=temperature)
-    return nxt, k_pages, v_pages
+        def layer(carry, xs):
+            hcur = carry
+            lp, kp, vp = xs                    # per-layer params + pools
+            iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+            hn = _nn.rms_norm(hcur, iln, epsilon=eps)
+            nh = qw.shape[1] // head_dim
+            q = jnp.matmul(hn, qw).reshape(b, nh, head_dim)
+            k = jnp.matmul(hn, kw).reshape(b, kvh, head_dim)
+            v = jnp.matmul(hn, vw).reshape(b, kvh, head_dim)
+            qf = q.astype(jnp.float32)
+            kf = k.astype(jnp.float32)
+            q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
+            k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
+            kp, vp = paged_write(kp, vp, k, v, tables, lens)
+            attn = attend(q, kp, vp, tables, lens + 1)  # incl. new tok
+            hcur = hcur + jnp.matmul(attn.reshape(b, nh * head_dim), ow)
+            hn = _nn.rms_norm(hcur, pln, epsilon=eps)
+            ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
+            return hcur + jnp.matmul(ff, dw), (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer, x, (tuple(stack), k_pages, v_pages))
+        x = _nn.rms_norm(x, norm_w, epsilon=eps)
+        logits = jnp.matmul(x, head_w.T if transpose_head else head_w)
+        key, sub = jax.random.split(key)
+        nxt, _ = sample_logits(logits, sub, strategy=strategy,
+                               top_k=top_k, top_p=top_p,
+                               temperature=temperature)
+        return (nxt, positions + 1, lens + 1, k_pages, v_pages, key)
+
+    if n_steps == 1:
+        nxt, _, _, k_pages, v_pages, _ = one_token(
+            (tokens, positions, lens, k_pages, v_pages, key))
+        return nxt[None], k_pages, v_pages
+
+    def body(carry, _):
+        carry = one_token(carry)
+        return carry, carry[0]
+
+    (_, _, _, k_pages, v_pages, _), toks = jax.lax.scan(
+        body, (tokens, positions, lens, k_pages, v_pages, key),
+        None, length=n_steps)
+    return toks, k_pages, v_pages
 
 
 class LLMEngine:
@@ -211,12 +234,15 @@ class LLMEngine:
                  page_size: int = 128, n_pages: Optional[int] = None,
                  dtype=np.float32, decode_strategy: str = "greedy_search",
                  top_k: int = 0, top_p: float = 1.0,
-                 temperature: float = 1.0, seed: int = 0):
+                 temperature: float = 1.0, seed: int = 0,
+                 steps_per_sync: int = 1):
         import jax
         import jax.numpy as jnp
 
         enforce(decode_strategy in ("greedy_search", "sampling"),
                 f"unsupported decode_strategy {decode_strategy!r}")
+        enforce(steps_per_sync >= 1, "steps_per_sync must be >= 1")
+        self.steps_per_sync = steps_per_sync
         self.decode_strategy = decode_strategy
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -327,8 +353,13 @@ class LLMEngine:
 
     # -- decode loop -----------------------------------------------------------
     def step(self) -> Dict[object, int]:
-        """One decode token for every active request; returns
-        {request_id: new_token} and retires finished requests."""
+        """Decode up to ``steps_per_sync`` tokens for every active
+        request in one device dispatch; returns {request_id:
+        last_new_token} and retires finished requests.  The host only
+        syncs (EOS checks, admission window) once per call, so over a
+        high-latency dispatch path (remote PJRT) throughput scales with
+        steps_per_sync; the window never exceeds any request's
+        remaining token budget, so page capacity is exact."""
         import jax
         import jax.numpy as jnp
 
@@ -336,6 +367,14 @@ class LLMEngine:
             return {}
         batch = list(self._active)
         n = len(batch)
+        nsteps = min([self.steps_per_sync] +
+                     [r.max_new - len(r.out) for r in batch])
+        nsteps = max(nsteps, 1)
+        # bucket the window to a power of two so ragged remaining
+        # budgets compile at most log2(steps_per_sync) decode programs
+        # (n_steps is a static jit arg), not one per distinct tail
+        while nsteps & (nsteps - 1):
+            nsteps &= nsteps - 1
         # pad to max_seqs: continuous batching must keep ONE compiled
         # shape as requests join/leave (dummy rows write into the
         # reserved pad page 0 with len 0 and are discarded)
@@ -344,7 +383,7 @@ class LLMEngine:
         tokens = np.array([r.out[-1] for r in batch] + [0] * pad,
                           np.int32)
         for s in slots:
-            self.cache.extend(int(s), 1)
+            self.cache.extend(int(s), nsteps)
         lens = np.concatenate([self.cache.seq_lens[slots],
                                np.zeros(pad, np.int32)])
         tables = np.concatenate(
@@ -353,7 +392,7 @@ class LLMEngine:
                       np.int32)])
 
         self._key, sub = jax.random.split(self._key)
-        nxt, self.cache.k_pages, self.cache.v_pages = _paged_decode_step(
+        toks, self.cache.k_pages, self.cache.v_pages = _paged_decode_step(
             self._stack, self._norm_w, self._head_w, self._embed_w,
             self._rope, self.cache.k_pages, self.cache.v_pages,
             jnp.asarray(tokens), jnp.asarray(lens, np.int32),
@@ -361,20 +400,23 @@ class LLMEngine:
             eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
             transpose_head=self._tied, strategy=self.decode_strategy,
             top_k=self.top_k, top_p=self.top_p,
-            temperature=self.temperature)
-        self.cache.advance(slots, 1)
-        nxt = np.asarray(jax.device_get(nxt))[:n]
+            temperature=self.temperature, n_steps=nsteps)
+        self.cache.advance(slots, nsteps)
+        toks = np.asarray(jax.device_get(toks))[:, :n]   # [nsteps, n]
 
         out = {}
         for i, req in enumerate(batch):
-            tok = int(nxt[i])
-            req.out.append(tok)
-            out[req.rid] = tok
-            if (req.eos is not None and tok == req.eos) or \
-                    len(req.out) >= req.max_new:
-                req.done = True
-                self.cache.release(req.slot)
-                self._active.remove(req)
+            for j in range(nsteps):
+                if req.done:
+                    break
+                tok = int(toks[j, i])
+                req.out.append(tok)
+                out[req.rid] = tok
+                if (req.eos is not None and tok == req.eos) or \
+                        len(req.out) >= req.max_new:
+                    req.done = True
+                    self.cache.release(req.slot)
+                    self._active.remove(req)
         return out
 
     def has_work(self) -> bool:

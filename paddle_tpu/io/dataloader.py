@@ -325,7 +325,17 @@ class DataLoader:
             class _NullSink:
                 def write(self, _):
                     return None
+
+            def _main_defined(obj):
+                # classes pickle by reference: a __main__-defined dataset
+                # pickles fine but the forkserver child can't import it
+                return getattr(type(obj), "__module__", "") == "__main__"
             try:
+                if _main_defined(self.dataset) or (
+                        self.worker_init_fn is not None
+                        and getattr(self.worker_init_fn, "__module__",
+                                    "") == "__main__"):
+                    raise TypeError("__main__-defined: use fork")
                 pickle.Pickler(_NullSink(),
                                protocol=pickle.HIGHEST_PROTOCOL).dump(
                     (self.dataset, self.worker_init_fn))

@@ -24,7 +24,12 @@ def _resolve_policy(policy):
     if policy is None or callable(policy):
         return policy
     if policy == "core_attn":
-        return jax.checkpoint_policies.save_only_these_names("attn_out")
+        # "attn_out" = the jnp attention path's saved output;
+        # "flash_out"/"flash_lse" = the pallas kernel's (out, lse) pair
+        # — saving BOTH lets the rematerialized backward skip the flash
+        # forward kernel entirely (its outputs are dead ⇒ XLA drops it)
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "flash_out", "flash_lse")
     if policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     raise ValueError(f"unknown recompute policy {policy!r}")

@@ -503,6 +503,14 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     per_chunk = n_layers // n_chunks
     stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
                for p in params]
+    if n_virtual == 1:
+        # training default: fused 1F1B schedule (activation memory ∝ pp
+        # in-flight microbatches, not n_micro); custom_vjp, so this is
+        # also the eval path (plain fwd pipeline) when not under grad
+        from ..distributed.pipeline import pipeline_train_1f1b
+        return pipeline_train_1f1b(
+            stage_fn, tail_fn, pm.mesh, pp_axis, tuple(stacked), xm,
+            (cos, sin), (norm_w, head_w), (lm,))
     loss_sum, count = gpipe_spmd(
         stacked, xm, stage_fn, cos, sin, mesh=pm.mesh, pp_axis=pp_axis,
         n_virtual=n_virtual, tail_fn=tail_fn,

@@ -72,14 +72,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             except NotImplementedError:
                 pass  # shape not sep-shardable; plain paths below
     from ..tensor import Tensor as _T
-    # a TRAINED attention bias must take the jnp path: the pallas masked
-    # kernel treats the mask as a constant (zero gradient)
+    # a TRAINED additive bias keeps its REAL gradient via the dmask
+    # kernel (round 3); boolean trainable masks make no sense, and a
+    # query-broadcast trainable bias is not kernel-covered — those fall
+    # back to the jnp path below via NotImplementedError
     mask_trainable = (isinstance(attn_mask, _T)
                       and not attn_mask.stop_gradient)
     use_pallas = (
         get_flag("use_pallas")
-        and dropout_p == 0.0
-        and not mask_trainable
         and is_compiled_with_tpu()
     )
     if use_pallas:
@@ -87,20 +87,45 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if kernel is not None:
             mask = attn_mask
             if mask is not None:
-                mval = mask.value if isinstance(mask, _T) else jnp.asarray(
-                    mask)
-                # bool masks (True = attend) become additive -inf bias
-                if mval.dtype == jnp.bool_:
-                    mval = jnp.where(mval, 0.0, -1e30).astype(jnp.float32)
-                mask = mval
+                if mask_trainable:
+                    mask = attn_mask      # keep the Tensor: grads flow
+                else:
+                    mval = mask.value if isinstance(mask, _T) \
+                        else jnp.asarray(mask)
+                    # bool masks (True = attend) → additive -inf bias
+                    if mval.dtype == jnp.bool_:
+                        mval = jnp.where(mval, 0.0,
+                                         -1e30).astype(jnp.float32)
+                    mask = mval
+            dp = float(dropout_p) if training else 0.0
             try:
                 # NotImplementedError is the kernel's documented "shape not
                 # covered" signal; anything else is a real bug and must
                 # propagate (ADVICE.md round-1)
+                if mask_trainable or dp > 0.0:
+                    import jax as _jax
+
+                    from ..ops import random as _R
+                    from ..ops.pallas.flash_attention import \
+                        flash_attention_raw_ext
+                    seed = _jax.random.randint(
+                        _R.split_key(), (), 0, 2**31 - 1,
+                        dtype=jnp.int32) if dp > 0.0 \
+                        else jnp.zeros((), jnp.int32)
+                    return apply_op(flash_attention_raw_ext, query, key,
+                                    value, mask, seed, causal=is_causal,
+                                    dropout_p=dp,
+                                    mask_grad=mask_trainable)
                 return apply_op(kernel, query, key, value, causal=is_causal,
                                 mask=mask)
             except NotImplementedError:
                 pass
+    if mask_trainable:
+        # positional-mask variant keeps the trainable bias on the tape
+        # (kwargs are static to the op layer)
+        return _api.sdpa_with_mask(
+            query, key, value, attn_mask, dropout_p=dropout_p,
+            is_causal=is_causal, training=training)
     return _api.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training)

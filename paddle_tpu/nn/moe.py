@@ -265,15 +265,11 @@ class MoELayer(Layer):
                 balance_coef=self.gate.balance_loss_weight,
                 z_coef=self.gate.z_loss_weight, tm=self.group_tile,
                 interpret=jax.default_backend() != "tpu")
-            self.aux_loss = aux
-            if self.shared_gate is not None:
-                from . import functional as F_
-                out = out + self.shared_down(
-                    F_.silu(self.shared_gate(flat)) * self.shared_up(flat))
-            return apply_op(lambda a: a.reshape(b, s, h), out)
-        combine, dispatch, aux = self.gate(flat)
+        else:
+            combine, dispatch, aux = self.gate(flat)
+            out = moe_dispatch_combine(flat, combine, dispatch,
+                                       self.experts)
         self.aux_loss = aux
-        out = moe_dispatch_combine(flat, combine, dispatch, self.experts)
         if self.shared_gate is not None:
             from . import functional as F_
             out = out + self.shared_down(

@@ -383,3 +383,98 @@ def atleast_2d(*xs):
 def atleast_3d(*xs):
     out = [jnp.atleast_3d(x) for x in xs]
     return out[0] if len(out) == 1 else out
+
+
+# -- round-3 long tail (SURVEY §2.2 tensor/math row) ------------------------
+
+def index_fill(x, index, axis, value):
+    """paddle.index_fill: rows at ``index`` along ``axis`` set to value."""
+    x = jnp.asarray(x)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[jnp.asarray(index, jnp.int32)].set(value)
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def select_scatter(x, values, axis, index):
+    """Embed ``values`` into x at position ``index`` along ``axis``."""
+    x = jnp.asarray(x)
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].set(jnp.asarray(values, x.dtype))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    """paddle.slice_scatter: write ``value`` into the strided slice."""
+    import builtins
+    x = jnp.asarray(x)
+    # NB: ``slice`` the builtin is shadowed by the paddle slice op above
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(st, en, sd)
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    """Write ``y`` onto the (offset) diagonal of x over (axis1, axis2)."""
+    x = jnp.asarray(x)
+    n1, n2 = x.shape[axis1], x.shape[axis2]
+    if offset >= 0:
+        dlen = min(n1, n2 - offset)
+        i1 = jnp.arange(dlen)
+        i2 = jnp.arange(dlen) + offset
+    else:
+        dlen = min(n1 + offset, n2)
+        i1 = jnp.arange(dlen) - offset
+        i2 = jnp.arange(dlen)
+    moved = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    y = jnp.asarray(y, x.dtype)
+    ybrd = jnp.moveaxis(y, -1, 0) if y.ndim == moved.ndim - 1 else y
+    moved = moved.at[i1, i2].set(ybrd)
+    return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    gen = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = jnp.asarray(list(gen), jnp.int32).reshape(-1, r)
+    return x[idx]
+
+
+def cartesian_prod(*xs):
+    grids = jnp.meshgrid(*[jnp.asarray(x) for x in xs], indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+
+def block_diag(*xs):
+    return jax.scipy.linalg.block_diag(*[jnp.asarray(x) for x in xs])
+
+
+def diag_embed(x, offset=0, axis1=-2, axis2=-1):
+    """Batched diagonal embedding (paddle.diag_embed)."""
+    x = jnp.asarray(x)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    # move the two new axes into place
+    nd = out.ndim
+    a1 = axis1 % nd
+    a2 = axis2 % nd
+    return jnp.moveaxis(out, (nd - 2, nd - 1), (a1, a2))
+
+
+def crop(x, shape=None, offsets=None):
+    """paddle.crop: slice ``shape`` starting at ``offsets``."""
+    x = jnp.asarray(x)
+    shape = list(x.shape if shape is None else shape)
+    shape = [x.shape[i] if s in (-1, None) else s
+             for i, s in enumerate(shape)]
+    import builtins
+    offsets = [0] * x.ndim if offsets is None else list(offsets)
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]

@@ -456,3 +456,128 @@ def polygamma(x, n):
 
 def equal_all(x, y):
     return jnp.array_equal(x, y)
+
+
+# -- round-3 long tail (PaddleNLP-recipe importability, SURVEY §2.2) --------
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, jnp.asarray(y, jnp.int32))
+
+
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+def exp2(x):
+    return jnp.exp2(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def sgn(x):
+    """Complex-aware sign (paddle.sgn): x/|x| for complex, sign for real."""
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def polar(abs_, angle_):
+    """paddle.polar: complex from magnitude and phase."""
+    return abs_ * jnp.exp(1j * angle_)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    if is_arithmetic:
+        # arithmetic shift preserves sign (jnp.right_shift on signed)
+        return jnp.right_shift(x, y)
+    # logical shift: view the bits as unsigned, shift, view back
+    x = jnp.asarray(x)
+    u = {jnp.int8: jnp.uint8, jnp.int16: jnp.uint16,
+         jnp.int32: jnp.uint32}.get(x.dtype.type)
+    if u is None:                      # already unsigned
+        return jnp.right_shift(x, y)
+    return jax.lax.bitcast_convert_type(
+        jnp.right_shift(jax.lax.bitcast_convert_type(x, u),
+                        jnp.asarray(y, u)), x.dtype)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    d = 1.0 if dx is None else dx
+    y = jnp.asarray(y)
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        x = jnp.asarray(x)
+        if x.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = x.shape[0]
+            x = x.reshape(shape)
+        dxs = (jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+               - jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis))
+        return jnp.cumsum((y0 + y1) / 2.0 * dxs, axis=axis)
+    return jnp.cumsum((y0 + y1) / 2.0 * d, axis=axis)
+
+
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm((x - y).ravel(), ord=p)

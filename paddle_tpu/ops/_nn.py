@@ -545,6 +545,17 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
 
 # -- attention --------------------------------------------------------------
 
+def sdpa_with_mask(query, key, value, attn_mask, dropout_p=0.0,
+                   is_causal=False, training=True, scale=None):
+    """scaled_dot_product_attention with the mask as a POSITIONAL tensor
+    input: keyword args are static to the op layer, so a trainable
+    additive bias passed as ``attn_mask=`` would silently lose its
+    gradient — this entry keeps it on the tape."""
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training, scale=scale)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, scale=None):

@@ -137,3 +137,25 @@ def test_engine_sampling_decode(model):
     while eng.has_work():
         eng.step()
     assert len(eng.result("s")) == 6
+
+
+def test_multi_step_decode_matches_single_step(model):
+    """steps_per_sync>1 (multi-step scheduling) must produce the same
+    greedy stream as per-token stepping."""
+    pa, pb = [5, 9, 2, 14], [3, 3, 7]
+    want_a = _greedy_reference(model, pa, 8)
+    want_b = _greedy_reference(model, pb, 5)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8,
+                    steps_per_sync=3)
+    eng.add_request("a", pa, max_new_tokens=8)
+    eng.add_request("b", pb, max_new_tokens=5)
+    calls = 0
+    while eng.has_work():
+        eng.step()
+        calls += 1
+    assert eng.result("a") == want_a
+    assert eng.result("b") == want_b
+    # the window is capped by the smallest remaining budget, then
+    # continues for the longer request — far fewer dispatches than tokens
+    assert calls < 8
+    assert eng.cache.free_page_count() == eng.cache.n_pages - 1

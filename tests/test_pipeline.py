@@ -248,3 +248,133 @@ def test_seg_methods():
               nn.ReLU()]
     pl = PipelineLayer(layers, num_stages=2, seg_method="layer:Linear")
     assert pl.segment_parts[1] in (1, 3)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B fused-backward engine
+# ---------------------------------------------------------------------------
+
+def _toy_1f1b_setup(nm, s=4, h=32, mb=4, per=2, seed=0):
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:s]), ("pp",))
+
+    def stage_fn(locals_, x):
+        (ws,) = locals_
+
+        def body(x, w):
+            return jnp.tanh(x @ w), None
+        x, _ = jax.lax.scan(body, x, ws)
+        return x
+
+    def tail_fn(tp, y, lbl):
+        (v,) = tp
+        z = y @ v
+        return jnp.sum((z - lbl) ** 2), jnp.asarray(z.size, jnp.float32)
+
+    rng = np.random.default_rng(seed)
+    ws = jnp.asarray(rng.standard_normal((s, per, h, h)) * 0.1,
+                     jnp.float32)
+    xm = jnp.asarray(rng.standard_normal((nm, mb, h)), jnp.float32)
+    lm = jnp.asarray(rng.standard_normal((nm, mb, h)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, h)) * 0.1, jnp.float32)
+    return mesh, stage_fn, tail_fn, ws, xm, lm, v
+
+
+def test_1f1b_loss_and_grads_match_serial():
+    from paddle_tpu.distributed.pipeline import pipeline_train_1f1b
+    import jax.numpy as jnp
+
+    s, per, nm, mb, h = 4, 2, 4, 4, 32
+    mesh, stage_fn, tail_fn, ws, xm, lm, v = _toy_1f1b_setup(nm, s=s,
+                                                             h=h, mb=mb,
+                                                             per=per)
+
+    def loss_1f1b(ws, v, xm):
+        return pipeline_train_1f1b(stage_fn, tail_fn, mesh, "pp",
+                                   (ws,), xm, (), (v,), (lm,))
+
+    def loss_serial(ws, v, xm):
+        x = xm.reshape(nm * mb, h)
+        for si in range(s):
+            for pi in range(per):
+                x = jnp.tanh(x @ ws[si, pi])
+        z = x @ v
+        return jnp.sum((z - lm.reshape(nm * mb, h)) ** 2) / (nm * mb * h)
+
+    np.testing.assert_allclose(
+        float(jax.jit(loss_1f1b)(ws, v, xm)),
+        float(loss_serial(ws, v, xm)), rtol=2e-5)
+    g1 = jax.jit(jax.grad(loss_1f1b, argnums=(0, 1, 2)))(ws, v, xm)
+    gs = jax.grad(loss_serial, argnums=(0, 1, 2))(ws, v, xm)
+    for a, b in zip(g1, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-4)
+
+
+def test_1f1b_activation_memory_independent_of_n_micro():
+    """The VERDICT r2 acceptance test: 1F1B's compiled peak temp memory
+    must be bounded by in-flight microbatches (∝ pp), not n_micro —
+    while the grad-through-loop GPipe path grows with n_micro."""
+    from paddle_tpu.distributed.pipeline import (gpipe_spmd,
+                                                 pipeline_train_1f1b)
+    import jax.numpy as jnp
+
+    def temps(nm, use_1f1b):
+        mesh, stage_fn, tail_fn, ws, xm, lm, v = _toy_1f1b_setup(nm)
+
+        if use_1f1b:
+            def loss(ws, v):
+                return pipeline_train_1f1b(stage_fn, tail_fn, mesh,
+                                           "pp", (ws,), xm, (), (v,),
+                                           (lm,))
+        else:
+            def loss(ws, v):
+                su, c = gpipe_spmd([ws], xm, stage_fn, mesh=mesh,
+                                   pp_axis="pp", tail_fn=tail_fn,
+                                   tail_params=(v,), tail_indexed=(lm,))
+                return su / jnp.maximum(c, 1.0)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        c = g.lower(ws, v).compile()
+        return c.memory_analysis().temp_size_in_bytes
+
+    t4, t32 = temps(4, True), temps(32, True)
+    g4, g32 = temps(4, False), temps(32, False)
+    # 1F1B: flat in n_micro (ring buffer of 2S microbatch inputs)
+    assert t32 <= t4 * 1.25, (t4, t32)
+    # grad-through-loop stores residuals per tick: grows with n_micro
+    assert g32 >= g4 * 1.5, (g4, g32)
+
+
+def test_pipe_1f1b_training_grads_match_serial_model():
+    """pp=4 mesh: gradients through the llama Pipe (1F1B custom_vjp)
+    equal the no-mesh serial gradients."""
+    cfg = _cfg4()
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=4)
+    ids, labels = _batch(cfg, b=8, seed=7)
+
+    saved = auto_parallel._GLOBAL_MESH
+    auto_parallel._GLOBAL_MESH = None
+    try:
+        loss = pipe(ids, labels=labels)
+        loss.backward()
+        serial = {n: np.asarray(p.grad.numpy()).copy()
+                  for n, p in pipe.named_parameters()
+                  if p.grad is not None}
+        pipe.clear_gradients()
+    finally:
+        auto_parallel._GLOBAL_MESH = saved
+
+    _pp_mesh(4)
+    loss = pipe(ids, labels=labels)
+    loss.backward()
+    n_checked = 0
+    for n, p in pipe.named_parameters():
+        if p.grad is None or n not in serial:
+            continue
+        np.testing.assert_allclose(np.asarray(p.grad.numpy()),
+                                   serial[n], atol=2e-4, rtol=2e-3,
+                                   err_msg=n)
+        n_checked += 1
+    assert n_checked >= 5
