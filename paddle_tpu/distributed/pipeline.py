@@ -50,11 +50,16 @@ __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "gpipe_spmd",
 # The compiled SPMD pipeline engine
 # ---------------------------------------------------------------------------
 
+def _typeof(x):
+    fn = getattr(jax, "typeof", None)
+    return fn(x) if fn is not None else jax.core.get_aval(x)
+
+
 def _pvary(x, axis):
     # no-op when already varying over this axis (pcast rejects that);
     # any OTHER ValueError (bad axis name etc.) must surface here, not
     # as an opaque vma mismatch deep in the scan
-    aval = getattr(jax, "typeof", jax.core.get_aval)(x)
+    aval = _typeof(x)
     if axis in getattr(aval, "vma", ()):
         return x
     if hasattr(jax.lax, "pcast"):
@@ -357,8 +362,7 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                     locals_, sinp, tuple(tail_params))
                 def seed(p, fill):
                     ct = jnp.full(p.shape, fill, p.dtype)
-                    aval = getattr(jax, "typeof", jax.core.get_aval)(p)
-                    if pp_axis in getattr(aval, "vma", ()):
+                    if pp_axis in getattr(_typeof(p), "vma", ()):
                         ct = _pvary(ct, pp_axis)
                     return ct
                 dch, dip, dtp = vjp((seed(s_, 1.0), seed(c_, 0.0)))

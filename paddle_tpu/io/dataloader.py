@@ -326,18 +326,21 @@ class DataLoader:
                 def write(self, _):
                     return None
 
-            def _main_defined(obj):
-                # classes pickle by reference: a __main__-defined dataset
-                # pickles fine but the forkserver child can't import it
-                return getattr(type(obj), "__module__", "") == "__main__"
+            class _Probe(pickle.Pickler):
+                # anything pickled BY REFERENCE to __main__ (classes,
+                # functions, partial targets, nested transforms) would
+                # fail to re-import in a forkserver child — reject it
+                # wherever it appears in the object graph
+                def reducer_override(self, obj):
+                    if getattr(obj, "__module__", None) == "__main__" \
+                            or getattr(type(obj), "__module__",
+                                       None) == "__main__":
+                        raise pickle.PicklingError(
+                            "__main__-defined: use fork")
+                    return NotImplemented
             try:
-                if _main_defined(self.dataset) or (
-                        self.worker_init_fn is not None
-                        and getattr(self.worker_init_fn, "__module__",
-                                    "") == "__main__"):
-                    raise TypeError("__main__-defined: use fork")
-                pickle.Pickler(_NullSink(),
-                               protocol=pickle.HIGHEST_PROTOCOL).dump(
+                _Probe(_NullSink(),
+                       protocol=pickle.HIGHEST_PROTOCOL).dump(
                     (self.dataset, self.worker_init_fn))
                 method = "forkserver"
             except Exception:

@@ -49,6 +49,10 @@ class Ernie45Config:
     max_position_embeddings: int = 131072
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
+    # ERNIE-4.5 checkpoints use GPT-J-interleaved rope; the converter
+    # permutes q/k lanes so the model runs the fast contiguous rope with
+    # identical numerics (set True only for unconverted parity checks)
+    rope_interleaved: bool = False
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
@@ -73,6 +77,7 @@ class Ernie45Config:
             rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
             initializer_range=self.initializer_range,
             tie_word_embeddings=self.tie_word_embeddings,
+            rope_interleaved=self.rope_interleaved,
             use_flash_attention=self.use_flash_attention,
             recompute=self.recompute,
             fuse_linear_cross_entropy=self.fuse_linear_cross_entropy)

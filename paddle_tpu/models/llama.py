@@ -48,6 +48,8 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
+    attention_bias: bool = False     # qkv/o biases (Qwen2-family True)
+    rope_interleaved: bool = False   # GPT-J pairing (ERNIE-4.5 True)
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
@@ -83,19 +85,38 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def _apply_rope_raw(q, k, cos, sin):
-    """q/k: [B, S, H, D]; cos/sin: [S, D] (f32 compute)."""
+def _rotate_half_interleaved(x):
+    """GPT-J-style pairing over (even, odd) lanes — the ERNIE-4.5
+    convention (its cos/sin stay in the llama cat(freqs, freqs)
+    layout)."""
     import jax.numpy as jnp
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _apply_rope_raw(q, k, cos, sin, interleaved: bool = False):
+    """q/k: [B, S, H, D]; cos/sin: [S, D] in the cat(freqs, freqs)
+    layout (f32 compute).  ``interleaved`` applies the GLM/ERNIE-4.5
+    convention: lanes pair as (2i, 2i+1) and BOTH use angle θ_i, so the
+    angles are repeat_interleaved from the first half."""
+    import jax.numpy as jnp
+    if interleaved:
+        half = cos.shape[-1] // 2
+        cos = jnp.repeat(cos[..., :half], 2, axis=-1)
+        sin = jnp.repeat(sin[..., :half], 2, axis=-1)
+    rot = _rotate_half_interleaved if interleaved else _rotate_half
     cos = cos[None, :, None, :]
     sin = sin[None, :, None, :]
     qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
-    q_out = qf * cos + _rotate_half(qf) * sin
-    k_out = kf * cos + _rotate_half(kf) * sin
+    q_out = qf * cos + rot(qf) * sin
+    k_out = kf * cos + rot(kf) * sin
     return q_out.astype(q.dtype), k_out.astype(k.dtype)
 
 
-def apply_rotary_pos_emb(q, k, cos, sin):
-    return apply_op(_apply_rope_raw, q, k, cos, sin)
+def apply_rotary_pos_emb(q, k, cos, sin, interleaved: bool = False):
+    return apply_op(_apply_rope_raw, q, k, cos, sin,
+                    interleaved=interleaved)
 
 
 def _seq_parallel_raw(x):
@@ -137,12 +158,13 @@ class LlamaAttention(Layer):
         init = Normal(0.0, c.initializer_range)
         out_init = Normal(0.0, c.initializer_range /
                           math.sqrt(2 * c.num_hidden_layers))
+        qkv_bias = getattr(c, "attention_bias", False)
         self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
-                             weight_attr=init, bias_attr=False)
+                             weight_attr=init, bias_attr=qkv_bias)
         self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
-                             weight_attr=init, bias_attr=False)
+                             weight_attr=init, bias_attr=qkv_bias)
         self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
-                             weight_attr=init, bias_attr=False)
+                             weight_attr=init, bias_attr=qkv_bias)
         self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
                              weight_attr=out_init, bias_attr=False)
         # Megatron TP layout
@@ -151,6 +173,7 @@ class LlamaAttention(Layer):
         self.v_proj.weight.dist_spec = (None, "mp")
         self.o_proj.weight.dist_spec = ("mp", None)
         self.use_flash = config.use_flash_attention
+        self.rope_interleaved = getattr(config, "rope_interleaved", False)
 
     def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         b, s, _ = x.shape
@@ -158,7 +181,8 @@ class LlamaAttention(Layer):
         k = P.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         v = P.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         cos, sin = cos_sin
-        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                    interleaved=self.rope_interleaved)
         attn_fn = (F.scaled_dot_product_attention if self.use_flash
                    else F.scaled_dot_product_attention_ref)
         if pos is not None:
@@ -401,7 +425,8 @@ def _ckpt_name_attn(a):
     return checkpoint_name(a, "attn_out")
 
 
-def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
+def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps,
+                       rope_interleaved=False):
     """One Llama decoder layer on raw arrays (mirrors LlamaDecoderLayer;
     kept in sync by the pipe-vs-sequential parity test)."""
     import jax.numpy as jnp
@@ -413,7 +438,8 @@ def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
     q = jnp.matmul(hn, qw).reshape(b, s, n_heads, head_dim)
     k = jnp.matmul(hn, kw).reshape(b, s, n_kv, head_dim)
     v = jnp.matmul(hn, vw).reshape(b, s, n_kv, head_dim)
-    q, k = _apply_rope_raw(q, k, cos, sin)
+    q, k = _apply_rope_raw(q, k, cos, sin,
+                           interleaved=rope_interleaved)
     attn = _attn_for_shape(q, k, v).reshape(b, s, n_heads * head_dim)
     attn = _ckpt_name_attn(attn)
     h = h + jnp.matmul(attn, ow)
@@ -423,16 +449,17 @@ def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps):
 
 
 @functools.lru_cache(maxsize=32)
-def _pipe_stage_fn(n_heads, n_kv, head_dim, eps):
+def _pipe_stage_fn(n_heads, n_kv, head_dim, eps, rope_interleaved=False):
     """Stable per-config stage callable (the pipeline engine caches its
     compiled form keyed on this object)."""
     import jax
 
     def stage_fn(locals_, h, cos, sin):
         def body(h, lp):
-            return _decoder_layer_raw(lp, h, cos, sin, n_heads=n_heads,
-                                      n_kv=n_kv, head_dim=head_dim,
-                                      eps=eps), None
+            return _decoder_layer_raw(
+                lp, h, cos, sin, n_heads=n_heads, n_kv=n_kv,
+                head_dim=head_dim, eps=eps,
+                rope_interleaved=rope_interleaved), None
         h, _ = jax.lax.scan(body, h, tuple(locals_))
         return h
 
@@ -465,7 +492,7 @@ def _pipe_tail_fn(eps, transpose_head, ignore_index):
 def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
                          n_heads, n_kv, head_dim, eps, num_stages, n_micro,
                          transpose_head, pp_axis="pp", n_virtual=1,
-                         ignore_index=-100):
+                         ignore_index=-100, rope_interleaved=False):
     """Decoder stack + loss head as one SPMD pipeline program; the loss
     is computed per microbatch on the last stage (raw jax level)."""
     import jax.numpy as jnp
@@ -474,7 +501,8 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     from ..distributed.pipeline import gpipe_spmd
 
     pm = get_mesh()
-    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps)
+    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps,
+                              rope_interleaved)
     tail_fn = _pipe_tail_fn(eps, transpose_head, ignore_index)
     b = x.shape[0]
     n_layers = params[0].shape[0]
@@ -519,7 +547,8 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
 
 
 def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
-                    num_stages, n_micro, pp_axis="pp", n_virtual=1):
+                    num_stages, n_micro, pp_axis="pp", n_virtual=1,
+                    rope_interleaved=False):
     """Decoder stack as an SPMD GPipe/interleaved pipeline (raw jax level).
 
     params: 9 stacked arrays, each [L, ...] (order of _decoder_layer_raw).
@@ -530,7 +559,8 @@ def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
     from ..distributed.pipeline import gpipe_spmd
 
     n_layers = params[0].shape[0]
-    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps)
+    stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps,
+                              rope_interleaved)
 
     pm = get_mesh()
     pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
@@ -641,13 +671,15 @@ class LlamaForCausalLMPipe(Layer):
                 n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,
                 head_dim=self.head_dim, eps=c.rms_norm_eps,
                 num_stages=None, n_micro=self.n_microbatches,
-                transpose_head=tied, n_virtual=self.virtual_pp_degree)
+                transpose_head=tied, n_virtual=self.virtual_pp_degree,
+                rope_interleaved=getattr(c, "rope_interleaved", False))
         x = apply_op(
             _llama_pipe_raw, stack, x, cos, sin,
             n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,
             head_dim=self.head_dim, eps=c.rms_norm_eps,
             num_stages=None, n_micro=self.n_microbatches,
-            n_virtual=self.virtual_pp_degree)
+            n_virtual=self.virtual_pp_degree,
+            rope_interleaved=getattr(c, "rope_interleaved", False))
         x = self.norm(x)
         if self.lm_head is None:
             logits = P.matmul(x, self.embed_tokens.weight, transpose_y=True)

@@ -43,6 +43,9 @@ class Qwen2MoeConfig:
     num_experts_per_tok: int = 4
     capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.001
+    norm_topk_prob: bool = False     # HF Qwen2-MoE convention
+    use_shared_expert_gate: bool = True
+    attention_bias: bool = True      # Qwen2 qkv biases
     max_position_embeddings: int = 8192
     rms_norm_eps: float = 1e-6
     rope_theta: float = 1000000.0
@@ -63,6 +66,7 @@ class Qwen2MoeConfig:
             max_position_embeddings=self.max_position_embeddings,
             rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta,
             initializer_range=self.initializer_range,
+            attention_bias=self.attention_bias,
             use_flash_attention=self.use_flash_attention)
 
 
@@ -90,7 +94,9 @@ class Qwen2MoeDecoderLayer(Layer):
             shared_expert_intermediate=c.shared_expert_intermediate_size,
             balance_loss_weight=1.0,  # scaled by aux coef at model level
             init_std=c.initializer_range,
-            num_layers_scale=c.num_hidden_layers)
+            num_layers_scale=c.num_hidden_layers,
+            norm_topk_prob=c.norm_topk_prob,
+            use_shared_expert_gate=c.use_shared_expert_gate)
 
     def forward(self, x, cos_sin):
         x = x + self.self_attn(self.input_layernorm(x), cos_sin)

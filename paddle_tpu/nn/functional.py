@@ -88,7 +88,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             mask = attn_mask
             if mask is not None:
                 if mask_trainable:
-                    mask = attn_mask      # keep the Tensor: grads flow
+                    # keep the Tensor so grads flow; a bool mask can't
+                    # be "trainable" — treat it as constant instead of
+                    # feeding raw 0/1 to the additive kernel
+                    if attn_mask.dtype == jnp.bool_:
+                        mask_trainable = False
+                        mask = jnp.where(attn_mask.value, 0.0,
+                                         -1e30).astype(jnp.float32)
+                    else:
+                        mask = attn_mask
                 else:
                     mval = mask.value if isinstance(mask, _T) \
                         else jnp.asarray(mask)

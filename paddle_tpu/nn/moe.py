@@ -39,17 +39,19 @@ __all__ = ["TopKGate", "ExpertFFN", "MoELayer", "moe_dispatch_combine"]
 EP_AXES = ("ep", "dp", "sharding")
 
 
-def _router_topk(x, wg, *, k, balance_coef, z_coef):
-    """Shared router math: x [T,H], wg [H,E] -> gate_vals [T,k] (f32,
-    renormalised), expert_idx [T,k] (int32), aux_loss (scalar)."""
+def _router_topk(x, wg, *, k, balance_coef, z_coef, norm_topk=True):
+    """Shared router math: x [T,H], wg [H,E] -> gate_vals [T,k] (f32),
+    expert_idx [T,k] (int32), aux_loss (scalar).  ``norm_topk``
+    renormalises the top-k gate values (Mixtral convention; HF
+    Qwen2-MoE ships norm_topk_prob=False)."""
     e = wg.shape[1]
     logits = jnp.dot(x.astype(jnp.float32), wg.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)                  # [T, E]
 
     gate_vals, expert_idx = jax.lax.top_k(probs, k)          # [T, k]
-    # renormalize the top-k gate values (Qwen2/Mixtral convention)
-    gate_vals = gate_vals / jnp.clip(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    if norm_topk:
+        gate_vals = gate_vals / jnp.clip(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
     # aux load-balance loss over the FULL top-k assignment density (the
     # reference's top-k gates count every selected slot, not just slot 0 —
@@ -64,13 +66,15 @@ def _router_topk(x, wg, *, k, balance_coef, z_coef):
     return gate_vals, expert_idx, aux
 
 
-def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef):
+def _gate_raw(x, wg, *, k, capacity, balance_coef, z_coef,
+              norm_topk=True):
     """Router: x [T,H], wg [H,E] -> combine [T,E,C], dispatch [T,E,C],
     aux_loss (scalar).  Switch-style load-balance + router z-loss."""
     t = x.shape[0]
     e = wg.shape[1]
     gate_vals, expert_idx, aux = _router_topk(
-        x, wg, k=k, balance_coef=balance_coef, z_coef=z_coef)
+        x, wg, k=k, balance_coef=balance_coef, z_coef=z_coef,
+        norm_topk=norm_topk)
 
     # capacity positions: for each (slot, expert) the position within the
     # expert's buffer = number of earlier tokens routed to it
@@ -102,14 +106,16 @@ def moe_dispatch_combine(x, combine, dispatch, expert_fn):
 
 
 def _moe_grouped_raw(x, router_w, gate_w, up_w, down_w, *, k,
-                     balance_coef, z_coef, tm, interpret):
+                     balance_coef, z_coef, tm, interpret,
+                     norm_topk=True):
     """Fused dropless MoE forward: router + sorted tile-aligned dispatch
     + Pallas grouped-matmul SwiGLU experts + top-k combine, all inside
     one raw fn so the integer routing tensors never surface as framework
     Tensors.  Returns (out [T,H], aux_loss)."""
     from ..ops.pallas.grouped_matmul import dropless_moe_ffn
     gate_vals, expert_idx, aux = _router_topk(
-        x, router_w, k=k, balance_coef=balance_coef, z_coef=z_coef)
+        x, router_w, k=k, balance_coef=balance_coef, z_coef=z_coef,
+        norm_topk=norm_topk)
     out = dropless_moe_ffn(x, gate_vals, expert_idx, gate_w, up_w,
                            down_w, tm=tm, interpret=interpret)
     return out, aux
@@ -121,13 +127,14 @@ class TopKGate(Layer):
     def __init__(self, hidden_size: int, num_experts: int, k: int = 2,
                  capacity_factor: float = 1.25,
                  balance_loss_weight: float = 0.01,
-                 z_loss_weight: float = 0.0):
+                 z_loss_weight: float = 0.0, norm_topk_prob: bool = True):
         super().__init__()
         self.num_experts = num_experts
         self.k = k
         self.capacity_factor = capacity_factor
         self.balance_loss_weight = balance_loss_weight
         self.z_loss_weight = z_loss_weight
+        self.norm_topk_prob = norm_topk_prob
         self.weight = self.create_parameter(
             [hidden_size, num_experts],
             default_initializer=Normal(0.0, 0.02))
@@ -141,7 +148,8 @@ class TopKGate(Layer):
         cap = self.capacity(int(np.prod(x.shape[:-1])))
         return apply_op(_gate_raw, x, self.weight, k=self.k, capacity=cap,
                         balance_coef=self.balance_loss_weight,
-                        z_coef=self.z_loss_weight)
+                        z_coef=self.z_loss_weight,
+                        norm_topk=self.norm_topk_prob)
 
 
 def _expert_ffn_raw(xe, wg, wu, wd):
@@ -203,7 +211,9 @@ class MoELayer(Layer):
                  init_std: float = 0.02, num_layers_scale: int = 1,
                  gate: Optional[TopKGate] = None, experts=None,
                  dispatch_mode: str = "auto",
-                 group_tile: Optional[int] = None):
+                 group_tile: Optional[int] = None,
+                 norm_topk_prob: bool = True,
+                 use_shared_expert_gate: bool = False):
         super().__init__()
         from ..common.errors import enforce
         enforce(dispatch_mode in ("auto", "dense", "grouped"),
@@ -212,7 +222,8 @@ class MoELayer(Layer):
         self.group_tile = group_tile
         self.gate = gate or TopKGate(
             hidden_size, num_experts, k=k, capacity_factor=capacity_factor,
-            balance_loss_weight=balance_loss_weight)
+            balance_loss_weight=balance_loss_weight,
+            norm_topk_prob=norm_topk_prob)
         self.experts = experts or ExpertFFN(
             num_experts, hidden_size, intermediate_size, init_std=init_std,
             num_layers_scale=num_layers_scale)
@@ -229,8 +240,16 @@ class MoELayer(Layer):
             self.shared_gate.weight.dist_spec = (None, "mp")
             self.shared_up.weight.dist_spec = (None, "mp")
             self.shared_down.weight.dist_spec = ("mp", None)
+            # HF Qwen2-MoE gates the shared expert with sigmoid(x @ W1)
+            if use_shared_expert_gate:
+                from .common import Linear
+                self.shared_expert_gate = Linear(hidden_size, 1,
+                                                 bias_attr=False)
+            else:
+                self.shared_expert_gate = None
         else:
             self.shared_gate = None
+            self.shared_expert_gate = None
         self.aux_loss: Optional[Tensor] = None
 
     def _resolve_dispatch(self) -> str:
@@ -264,7 +283,8 @@ class MoELayer(Layer):
                 self.experts.down_w, k=self.gate.k,
                 balance_coef=self.gate.balance_loss_weight,
                 z_coef=self.gate.z_loss_weight, tm=self.group_tile,
-                interpret=jax.default_backend() != "tpu")
+                interpret=jax.default_backend() != "tpu",
+                norm_topk=self.gate.norm_topk_prob)
         else:
             combine, dispatch, aux = self.gate(flat)
             out = moe_dispatch_combine(flat, combine, dispatch,
@@ -272,6 +292,10 @@ class MoELayer(Layer):
         self.aux_loss = aux
         if self.shared_gate is not None:
             from . import functional as F_
-            out = out + self.shared_down(
+            shared = self.shared_down(
                 F_.silu(self.shared_gate(flat)) * self.shared_up(flat))
+            if self.shared_expert_gate is not None:
+                shared = shared * F_.sigmoid(
+                    self.shared_expert_gate(flat))
+            out = out + shared
         return apply_op(lambda a: a.reshape(b, s, h), out)

@@ -548,7 +548,7 @@ def bitwise_right_shift(x, y, is_arithmetic=True):
     # logical shift: view the bits as unsigned, shift, view back
     x = jnp.asarray(x)
     u = {jnp.int8: jnp.uint8, jnp.int16: jnp.uint16,
-         jnp.int32: jnp.uint32}.get(x.dtype.type)
+         jnp.int32: jnp.uint32, jnp.int64: jnp.uint64}.get(x.dtype.type)
     if u is None:                      # already unsigned
         return jnp.right_shift(x, y)
     return jax.lax.bitcast_convert_type(

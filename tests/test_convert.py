@@ -75,3 +75,144 @@ def test_hf_bert_checkpoint_parity(tmp_path):
                                rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(np.asarray(pooled.numpy()), want_pool,
                                rtol=2e-3, atol=2e-3)
+
+
+def test_hf_gpt2_checkpoint_parity(tmp_path):
+    from transformers import GPT2Config as HFGPT2Config
+    from transformers import GPT2LMHeadModel as HFGPT2
+
+    hf_cfg = HFGPT2Config(
+        vocab_size=256, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        n_inner=128, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    hf = HFGPT2(hf_cfg).eval()
+    path = str(tmp_path / "gpt2.bin")
+    torch.save(hf.state_dict(), path)
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    ours = GPTForCausalLM(GPTConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, tie_word_embeddings=True))
+    ours.eval()
+    missing, unexpected = C.load_hf_gpt2(ours, path)
+    assert not missing, missing
+    assert not unexpected, unexpected
+
+    ids = np.random.default_rng(2).integers(0, 256, size=(2, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(ids.astype(np.int64)))
+                     .numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_ernie45_checkpoint_parity(tmp_path):
+    from transformers import Ernie4_5Config as HFErnieConfig
+    from transformers import Ernie4_5ForCausalLM as HFErnie
+
+    hf_cfg = HFErnieConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=128,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, use_bias=False)
+    torch.manual_seed(0)
+    hf = HFErnie(hf_cfg).eval()
+    path = str(tmp_path / "ernie.bin")
+    torch.save(hf.state_dict(), path)
+
+    from paddle_tpu.models.ernie import (Ernie45ForCausalLM,
+                                         ernie45_tiny_config)
+    paddle.seed(0)
+    ours = Ernie45ForCausalLM(ernie45_tiny_config())
+    ours.eval()
+    missing, unexpected = C.load_hf_ernie45(ours, path)
+    assert not missing, missing
+    assert not unexpected, unexpected
+
+    ids = np.random.default_rng(3).integers(0, 256, size=(2, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(ids.astype(np.int64)))
+                     .numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_qwen2_moe_checkpoint_parity(tmp_path):
+    from transformers import Qwen2MoeConfig as HFQwenConfig
+    from transformers import Qwen2MoeForCausalLM as HFQwen
+
+    hf_cfg = HFQwenConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, shared_expert_intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_experts=8, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        norm_topk_prob=False, qkv_bias=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        attention_dropout=0.0, output_router_logits=False)
+    torch.manual_seed(0)
+    hf = HFQwen(hf_cfg).eval()
+    path = str(tmp_path / "qwen.bin")
+    torch.save(hf.state_dict(), path)
+
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             qwen2_moe_tiny_config)
+    paddle.seed(0)
+    cfg = qwen2_moe_tiny_config()
+    # HF computes every routed token densely; ample capacity makes our
+    # dense-dispatch path dropless too (the grouped TPU path already is)
+    cfg.capacity_factor = float(cfg.num_experts)
+    ours = Qwen2MoeForCausalLM(cfg)
+    ours.eval()
+    missing, unexpected = C.load_hf_qwen2_moe(ours, path)
+    assert not missing, missing
+    assert not unexpected, unexpected
+
+    ids = np.random.default_rng(4).integers(0, 256, size=(2, 12))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(ours(paddle.to_tensor(ids.astype(np.int64)))
+                     .numpy())
+    np.testing.assert_allclose(got, want, rtol=4e-3, atol=4e-3)
+
+
+def test_export_hf_llama_round_trip(tmp_path):
+    """paddle_tpu -> HF export: save_hf_llama's checkpoint loads into a
+    transformers LlamaForCausalLM and reproduces our logits."""
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    from paddle_tpu.models.llama import LlamaForCausalLM, \
+        llama_tiny_config
+    paddle.seed(7)
+    ours = LlamaForCausalLM(llama_tiny_config())
+    ours.eval()
+    path = str(tmp_path / "export.bin")
+    C.save_hf_llama(ours, path)
+
+    hf_cfg = HFLlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attention_bias=False, mlp_bias=False)
+    hf = HFLlama(hf_cfg)
+    state = torch.load(path, weights_only=True)
+    missing, unexpected = hf.load_state_dict(state, strict=False)
+    assert not unexpected, unexpected
+    assert all("rotary" in m or "inv_freq" in m for m in missing), missing
+    hf.eval()
+
+    ids = np.random.default_rng(5).integers(0, 256, size=(2, 12))
+    want = np.asarray(ours(paddle.to_tensor(ids.astype(np.int64)))
+                      .numpy())
+    with torch.no_grad():
+        got = hf(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
