@@ -483,12 +483,20 @@ def bench_longseq():
 
 def main():
     if "--ladder" in sys.argv:
-        rows = [bench_headline(emit=False), bench_gpt2(), bench_ernie(),
-                bench_dit(), bench_moe(), bench_decode(), bench_engine(),
-                bench_longseq()]
-        for r in rows:
-            print(json.dumps(r))
-        return
+        # stream each row as it completes: a transient tunnel error in
+        # one row must not lose the rows already measured
+        fns = [lambda: bench_headline(emit=False), bench_gpt2,
+               bench_ernie, bench_dit, bench_moe, bench_decode,
+               bench_engine, bench_longseq]
+        failed = 0
+        for fn in fns:
+            try:
+                print(json.dumps(fn()), flush=True)
+            except Exception as e:
+                failed += 1
+                print(json.dumps({"metric": f"{fn.__name__}_ERROR",
+                                  "error": str(e)[:300]}), flush=True)
+        return 1 if failed else 0
     bench_headline()
 
 

@@ -222,6 +222,11 @@ def convert_hf_ernie45(state: Dict[str, np.ndarray],
     that converts ERNIE's interleaved rope into the fast contiguous
     layout (see _deinterleave_heads).  ``head_dim`` is required for the
     permutation (load_hf_ernie45 reads it off the target model)."""
+    enforce(head_dim is not None and head_dim > 0,
+            "convert_hf_ernie45 needs head_dim for the rope lane "
+            "permutation (it is shape-preserving, so skipping it would "
+            "load cleanly but attend with silently wrong numerics); "
+            "use load_hf_ernie45(model, path) to infer it")
     out = {}
     for k, v in state.items():
         nk = k
@@ -230,7 +235,7 @@ def convert_hf_ernie45(state: Dict[str, np.ndarray],
         if "rotary_emb" in nk:
             continue
         v = np.asarray(v)
-        if _ERNIE_QK.search(nk) and head_dim:
+        if _ERNIE_QK.search(nk):
             v = _deinterleave_heads(v, head_dim, axis=0)
         if _LLAMA_TRANSPOSE.search(nk):
             v = v.T
@@ -256,7 +261,6 @@ _QWEN_RENAMES = [
     (r"\.mlp\.shared_expert\.gate_proj\.", ".mlp.shared_gate."),
     (r"\.mlp\.shared_expert\.up_proj\.", ".mlp.shared_up."),
     (r"\.mlp\.shared_expert\.down_proj\.", ".mlp.shared_down."),
-    (r"\.mlp\.shared_expert_gate\.", ".mlp.shared_expert_gate."),
 ]
 _QWEN_TRANSPOSE = re.compile(
     r"(q_proj|k_proj|v_proj|o_proj|lm_head|mlp\.gate|shared_gate|"
@@ -286,6 +290,10 @@ def convert_hf_qwen2_moe(state: Dict[str, np.ndarray]
             v = np.asarray(v).T
         out[nk] = np.asarray(v)
     for (layer, kind), by_id in experts.items():
+        enforce(sorted(by_id) == list(range(len(by_id))),
+                f"layer {layer} {kind}_proj: expert ids "
+                f"{sorted(by_id)} are not contiguous from 0 — partial "
+                "checkpoint shard? merge all shards before converting")
         stack = np.stack([by_id[i].T for i in range(len(by_id))])
         # gate/up: [E, H, F]; down: [E, F, H] — both from [out,in].T
         out[f"layers.{layer}.mlp.experts.{kind}_w"] = stack

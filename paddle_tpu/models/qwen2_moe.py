@@ -27,7 +27,8 @@ from ..tensor import Tensor
 from .llama import (LlamaAttention, LlamaConfig, LlamaPretrainingCriterion,
                     _rope_cos_sin)
 
-__all__ = ["Qwen2MoeConfig", "Qwen2MoeForCausalLM", "qwen2_moe_tiny_config"]
+__all__ = ["Qwen2MoeConfig", "Qwen2MoeForCausalLM", "qwen2_moe_tiny_config",
+           "deepseek_moe_16b_config"]
 
 
 @dataclass
@@ -68,6 +69,24 @@ class Qwen2MoeConfig:
             initializer_range=self.initializer_range,
             attention_bias=self.attention_bias,
             use_flash_attention=self.use_flash_attention)
+
+
+def deepseek_moe_16b_config() -> Qwen2MoeConfig:
+    """DeepSeekMoE-16B-class geometry (BASELINE configs row 5 names the
+    DeepSeekMoE/Qwen2-MoE family): fine-grained experts (64, top-6) +
+    shared experts, norm_topk disabled.  Same architecture class as
+    Qwen2-MoE (shared-expert SwiGLU MoE over a llama backbone); at 64
+    experts the dropless grouped-matmul path's adaptive row tile drops
+    to keep per-expert padding bounded."""
+    return Qwen2MoeConfig(
+        vocab_size=102400, hidden_size=2048, num_hidden_layers=28,
+        num_attention_heads=16, num_key_value_heads=16,
+        moe_intermediate_size=1408,
+        shared_expert_intermediate_size=2816,
+        num_experts=64, num_experts_per_tok=6,
+        max_position_embeddings=4096, rope_theta=10000.0,
+        norm_topk_prob=False, attention_bias=False,
+        use_shared_expert_gate=False)
 
 
 def qwen2_moe_tiny_config() -> Qwen2MoeConfig:

@@ -718,9 +718,11 @@ def flash_attention_raw(q, k, v, causal: bool = False, mask=None,
     if d not in (64, 128, 256) or h % hk or sq % 8 or sk % 8:
         raise NotImplementedError("flash kernel shape constraints")
     bq, bk = _pick_blocks(sq, sk, d)
-    if mask_grad:
-        # the dmask kernel holds a (bq, bk) f32 accumulator on top of
-        # the usual operands: stay at 512-wide blocks for VMEM
+    if mask_grad or dropout_p > 0.0:
+        # extra VMEM pressure in the backward kernels — the dmask path
+        # holds a (bq, bk) f32 accumulator, and dropout's PRNG keep-mask
+        # + rescaled-prob intermediates blow the 16M scoped-vmem limit
+        # at 1024-wide blocks (observed on v5e at d=64): stay at 512
         bq, bk = min(bq, 512), min(bk, 512)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

@@ -258,3 +258,39 @@ def test_moe_ep_axis_sharded_train_step():
     assert losses[-1] < losses[0]
     ew = step.state["params"]["layers.0.mlp.experts.gate_w"]
     assert "ep" in str(ew.sharding.spec)
+
+
+def test_deepseek_moe_class_many_experts_grouped_path():
+    """DeepSeekMoE-class geometry: 64 fine-grained experts top-6 — the
+    grouped path's adaptive tile bounds per-expert padding and the
+    layer still matches the ample-capacity dense path."""
+    from paddle_tpu.models.qwen2_moe import deepseek_moe_16b_config
+    cfg = deepseek_moe_16b_config()
+    assert cfg.num_experts == 64 and cfg.num_experts_per_tok == 6
+
+    rng = np.random.default_rng(5)
+    b, s, h, e, f, k = 2, 16, 32, 64, 16, 6
+    dense = MoELayer(h, e, f, k=k, capacity_factor=float(e),
+                     dispatch_mode="dense", norm_topk_prob=False)
+    grouped = MoELayer(h, e, f, k=k, dispatch_mode="grouped",
+                       group_tile=8, gate=dense.gate,
+                       experts=dense.experts)
+    x = paddle.to_tensor(rng.standard_normal((b, s, h)).astype(np.float32))
+    out_d = dense(x)
+    out_g = grouped(x)
+    np.testing.assert_allclose(np.asarray(out_g.numpy()),
+                               np.asarray(out_d.numpy()), atol=5e-3,
+                               rtol=2e-2)
+    # adaptive tile: the REAL tm=None resolution must keep per-expert
+    # padding bounded at 64 experts — probe via the plan the grouped
+    # path would build (padded rows <= slots + E*tile)
+    from paddle_tpu.ops.pallas.grouped_matmul import make_dropless_plan
+    import jax.numpy as jnp_
+    eidx = jnp_.asarray(rng.integers(0, e, (b * s, k)), jnp_.int32)
+    slots = b * s * k
+    _, _, _, _, m_pad_128 = make_dropless_plan(eidx, e, 128)
+    _, _, _, _, m_pad_512 = make_dropless_plan(eidx, e, 512)
+    assert m_pad_128 - slots <= e * 128 + 128
+    # tm=512 at this expert count would pad >100x the slot count —
+    # exactly why dropless_moe_ffn's auto tile stays at the 128 floor
+    assert m_pad_512 - slots >= e * 512
