@@ -49,6 +49,7 @@ class Model:
         self._eval_jits = {}
         self._pending_opt_state = None
         self._accum_grads = None
+        self._last_train_preds = None
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------------
@@ -71,14 +72,31 @@ class Model:
         outs = _as_list(out)
         return self._loss(*(outs + list(labs)))
 
+    def _loss_fn_aux(self, net, batch):
+        """Fused-step variant returning (loss, predictions): train
+        metrics then come from the SAME pre-update forward as the loss
+        (paddle parity) instead of a second post-update eval pass."""
+        ins, labs = batch["inputs"], batch["labels"]
+        out = net(*ins)
+        outs = _as_list(out)
+        return self._loss(*(outs + list(labs))), tuple(outs)
+
     def _ensure_train_step(self):
         if self._train_step is None:
             enforce(self._optimizer is not None and self._loss is not None,
                     "call prepare(optimizer=..., loss=...) before training")
             from ..jit.train import CompiledTrainStep
             self.network.train()
-            self._train_step = CompiledTrainStep(
-                self.network, self._loss_fn, self._optimizer)
+            # with metrics configured, the fused step also returns the
+            # training forward's predictions (has_aux) so per-batch
+            # train metrics cost no extra forward
+            if self._metrics:
+                self._train_step = CompiledTrainStep(
+                    self.network, self._loss_fn_aux, self._optimizer,
+                    has_aux=True)
+            else:
+                self._train_step = CompiledTrainStep(
+                    self.network, self._loss_fn, self._optimizer)
             if self._pending_opt_state is not None:
                 self._train_step.state["opt"] = self._pending_opt_state
                 self._pending_opt_state = None
@@ -126,8 +144,15 @@ class Model:
         batch = {"inputs": tuple(_as_list(inputs)),
                  "labels": tuple(_as_list(labels))}
         if update and self._accum_grads is None:
-            return [_to_host(step(batch))]     # fused fast path
+            out = step(batch)                  # fused fast path
+            if step._has_aux:
+                loss, preds = out
+                self._last_train_preds = preds
+                return [_to_host(loss)]
+            self._last_train_preds = None
+            return [_to_host(out)]
         # paddle update=False semantics: accumulate grads, defer update
+        self._last_train_preds = None   # no fused-forward preds here
         import jax
         loss, grads = step.grad_step(batch)
         if self._accum_grads is None:
@@ -209,15 +234,19 @@ class Model:
                 ins, labs = self._split_batch(batch)
                 logs = {"loss": self.train_batch(ins, labs)[0]}
                 if self._metrics:
-                    # metrics cost a second jitted forward (the fused
-                    # step returns only the loss); its post-update
-                    # eval-mode loss must NOT shadow the train loss.
-                    # Known drift vs paddle: these metrics see the
-                    # POST-update weights (paddle computes them on the
-                    # same forward as the loss) — one optimizer step of
-                    # skew, vanishing as training converges
-                    ev = self.eval_batch(ins, labs)
-                    mlogs = self._update_metrics(ev, labs)
+                    preds = self._last_train_preds
+                    self._last_train_preds = None  # consume: don't pin
+                    if preds is not None:
+                        # pre-update predictions from the SAME forward
+                        # as the loss (paddle semantics, zero extra cost)
+                        mlogs = self._update_metrics(
+                            {"preds": [Tensor(p) for p in preds]},
+                            _as_list(labs))
+                    else:
+                        # grad-accumulation path: fall back to an eval
+                        # forward (post-update, documented drift)
+                        ev = self.eval_batch(ins, labs)
+                        mlogs = self._update_metrics(ev, _as_list(labs))
                     mlogs.pop("loss", None)
                     logs.update(mlogs)
                 cbks.on_train_batch_end(step_i, logs)

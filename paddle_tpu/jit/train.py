@@ -70,7 +70,8 @@ class CompiledTrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
                  seed: int = 0, donate: bool = True,
                  out_shardings=None, state_sharding_fn=None,
-                 extra_metrics_fn: Optional[Callable] = None):
+                 extra_metrics_fn: Optional[Callable] = None,
+                 has_aux: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -84,19 +85,32 @@ class CompiledTrainStep:
         self._key = jax.random.key(seed)
         self._step_fn = None
         self._donate = donate
+        self._has_aux = has_aux
 
     def _make_step(self):
         """The raw (un-jitted) fused step fn: fwd+bwd+clip+update."""
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
 
+        has_aux = self._has_aux
+
         def step(state, batch, key, lr):
             def pure_loss(p):
                 return traced_forward(model, loss_fn, p, batch, key)
 
-            loss, grads = jax.value_and_grad(pure_loss)(state["params"])
+            if has_aux:
+                # loss_fn returns (loss, aux): aux rides along from the
+                # SAME pre-update forward (hapi train metrics use this —
+                # paddle computes metrics on the loss forward, not on a
+                # second post-update pass)
+                (loss, aux), grads = jax.value_and_grad(
+                    pure_loss, has_aux=True)(state["params"])
+            else:
+                loss, grads = jax.value_and_grad(pure_loss)(
+                    state["params"])
             new_params, new_opt = optimizer.apply_gradients(
                 state["params"], grads, state["opt"], lr=lr)
-            return {"params": new_params, "opt": new_opt}, loss
+            out = (loss, aux) if has_aux else loss
+            return {"params": new_params, "opt": new_opt}, out
 
         return step
 
@@ -110,12 +124,12 @@ class CompiledTrainStep:
             self._build()
         self._key, sub = jax.random.split(self._key)
         lr = self.optimizer.get_lr()
-        self.state, loss = self._step_fn(self.state, _to_arrays(batch), sub,
-                                         lr)
+        self.state, out = self._step_fn(self.state, _to_arrays(batch), sub,
+                                        lr)
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()
-        return loss
+        return out
 
     def eval_step(self, eval_fn: Callable, batch):
         """Compile-once eval step (no grad, no state mutation)."""
@@ -138,10 +152,15 @@ class CompiledTrainStep:
         gradient accumulation (paddle train_batch(update=False))."""
         if not hasattr(self, "_grad_fn"):
             model, loss_fn = self.model, self.loss_fn
+            has_aux = self._has_aux
 
             def gstep(params, batch, key):
                 def pure_loss(p):
                     return traced_forward(model, loss_fn, p, batch, key)
+                if has_aux:
+                    (loss, _aux), grads = jax.value_and_grad(
+                        pure_loss, has_aux=True)(params)
+                    return loss, grads
                 return jax.value_and_grad(pure_loss)(params)
 
             self._grad_fn = jax.jit(gstep)

@@ -246,3 +246,42 @@ def test_load_skip_mismatch(tmp_path):
     w_first = np.asarray(net2[0].weight.numpy())
     w_saved = np.asarray(m.network[0].weight.numpy())
     np.testing.assert_allclose(w_first, w_saved, rtol=1e-6)
+
+
+def test_fit_train_metrics_use_pre_update_forward():
+    """With metrics configured, fit computes them from the SAME forward
+    as the loss (has_aux fused step) — no second eval forward, paddle
+    semantics (ADVICE r2)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 8)).astype(np.float32)
+    w = rng.standard_normal((8,)).astype(np.float32)
+    y = (x @ w > 0).astype(np.int64)
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.AdamW(learning_rate=1e-2,
+                                         parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+
+    class _CountEval:
+        def __init__(self, m):
+            self.m, self.calls = m, 0
+            self._orig = m.eval_batch
+
+        def __call__(self, *a, **k):
+            self.calls += 1
+            return self._orig(*a, **k)
+    counter = _CountEval(model)
+    model.eval_batch = counter
+
+    model.fit(list(zip(x, y)), batch_size=8, epochs=1, verbose=0)
+    # metrics came from the fused step's aux — eval_batch never called
+    assert counter.calls == 0
+    assert model._train_step._has_aux
