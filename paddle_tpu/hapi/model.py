@@ -50,6 +50,7 @@ class Model:
         self._pending_opt_state = None
         self._accum_grads = None
         self._last_train_preds = None
+        self._in_fit = False
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------------
@@ -59,6 +60,12 @@ class Model:
         if loss is not None:
             enforce(callable(loss), "loss must be callable (a Layer or fn)")
         self._loss = loss
+        # re-preparing drops any compiled step: optimizer/loss/metrics
+        # are baked into it (incl. the has_aux choice), so a stale step
+        # would silently ignore the new configuration
+        if self._train_step is not None:
+            self._pending_opt_state = None
+            self._train_step = None
         self._metrics = _as_list(metrics)
         for m in self._metrics:
             enforce(isinstance(m, Metric),
@@ -147,7 +154,9 @@ class Model:
             out = step(batch)                  # fused fast path
             if step._has_aux:
                 loss, preds = out
-                self._last_train_preds = preds
+                # stash for fit's metrics pass only — direct
+                # train_batch callers must not pin a logits buffer
+                self._last_train_preds = preds if self._in_fit else None
                 return [_to_host(loss)]
             self._last_train_preds = None
             return [_to_host(out)]
@@ -223,6 +232,7 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
+        self._in_fit = True
         for epoch in range(epochs):
             if self.stop_training:
                 break
@@ -256,6 +266,8 @@ class Model:
                               verbose=verbose, num_workers=num_workers,
                               callbacks=cbks)
         cbks.on_train_end(logs)
+        self._in_fit = False
+        self._last_train_preds = None
         return self
 
     def _update_metrics(self, ev, labs):

@@ -69,9 +69,7 @@ class CompiledTrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
                  seed: int = 0, donate: bool = True,
-                 out_shardings=None, state_sharding_fn=None,
-                 extra_metrics_fn: Optional[Callable] = None,
-                 has_aux: bool = False):
+                 state_sharding_fn=None, has_aux: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer

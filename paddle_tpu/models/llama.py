@@ -50,6 +50,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False     # qkv/o biases (Qwen2-family True)
     rope_interleaved: bool = False   # GPT-J pairing (ERNIE-4.5 True)
+    fuse_qkv: bool = False           # single qkv matmul (concat weights)
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
@@ -174,12 +175,36 @@ class LlamaAttention(Layer):
         self.o_proj.weight.dist_spec = ("mp", None)
         self.use_flash = config.use_flash_attention
         self.rope_interleaved = getattr(config, "rope_interleaved", False)
+        self.fuse_qkv = getattr(config, "fuse_qkv", False)
 
     def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         b, s, _ = x.shape
-        q = P.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
-        k = P.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        v = P.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        if self.fuse_qkv:
+            # one [H, (nh+2*nkv)*hd] matmul: the weight concat is cheap
+            # relative to the fused MXU pass (weights stay separate
+            # Parameters for checkpoint/TP-spec compatibility)
+            nq = self.num_heads * self.head_dim
+            nkv = self.num_kv_heads * self.head_dim
+            w = P.concat([self.q_proj.weight, self.k_proj.weight,
+                          self.v_proj.weight], axis=1)
+            qkv = P.matmul(x, w)
+            if self.q_proj.bias is not None:
+                bias = P.concat([self.q_proj.bias, self.k_proj.bias,
+                                 self.v_proj.bias], axis=0)
+                qkv = qkv + bias
+            q = P.reshape(qkv[:, :, :nq],
+                          [b, s, self.num_heads, self.head_dim])
+            k = P.reshape(qkv[:, :, nq:nq + nkv],
+                          [b, s, self.num_kv_heads, self.head_dim])
+            v = P.reshape(qkv[:, :, nq + nkv:],
+                          [b, s, self.num_kv_heads, self.head_dim])
+        else:
+            q = P.reshape(self.q_proj(x),
+                          [b, s, self.num_heads, self.head_dim])
+            k = P.reshape(self.k_proj(x),
+                          [b, s, self.num_kv_heads, self.head_dim])
+            v = P.reshape(self.v_proj(x),
+                          [b, s, self.num_kv_heads, self.head_dim])
         cos, sin = cos_sin
         q, k = apply_rotary_pos_emb(q, k, cos, sin,
                                     interleaved=self.rope_interleaved)

@@ -113,3 +113,37 @@ class TestLlama:
         assert specs["llama.layers.0.self_attn.q_proj.weight"] == (None, "mp")
         assert specs["llama.layers.0.self_attn.o_proj.weight"] == ("mp", None)
         assert specs["llama.embed_tokens.weight"] == ("mp", None)
+
+
+def test_fuse_qkv_matches_separate_projections():
+    """LlamaConfig.fuse_qkv (single concat-weight qkv matmul) must be
+    numerically identical to the separate projections, including GQA
+    (nkv != nh) and qkv biases."""
+    import numpy as np
+
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, rope_theta=10000.0,
+                      attention_bias=True)
+    paddle.seed(11)
+    m1 = LlamaForCausalLM(cfg)
+    cfg2 = LlamaConfig(**{**cfg.__dict__, "fuse_qkv": True})
+    m2 = LlamaForCausalLM(cfg2)
+    m2.set_state_dict(m1.state_dict())
+
+    ids = paddle.to_tensor(np.random.default_rng(4).integers(
+        0, 128, (2, 16)).astype(np.int64))
+    a = np.asarray(m1(ids).numpy())
+    b = np.asarray(m2(ids).numpy())
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    loss = m2(ids, labels=ids)
+    loss.backward()
+    for proj in ("q_proj", "k_proj", "v_proj"):
+        lin = getattr(m2.llama.layers[0].self_attn, proj)
+        assert lin.weight.grad is not None
+        assert lin.bias.grad is not None
+        assert np.isfinite(np.asarray(lin.weight.grad.numpy())).all()
