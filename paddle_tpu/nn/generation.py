@@ -13,7 +13,9 @@ idiom that XLA would recompile on).  Prefill attends with the flash
 kernel (causal); decode steps are single-query cached attention
 (memory-bound; O(total_len) per step).  The token loop is a
 ``lax.scan`` with an EOS done-mask, sampling via
-``jax.random.categorical`` with top-k/top-p filtering.
+``jax.random.categorical`` with top-k/top-p filtering; beam search
+(round 3) also runs whole-loop-compiled — beams are an expanded batch
+and the per-step beam reorder is a cache gather inside the scan.
 """
 from __future__ import annotations
 
@@ -142,14 +144,21 @@ class GenerationMixin:
                  top_k: int = 0, top_p: float = 1.0,
                  temperature: float = 1.0,
                  eos_token_id: Optional[int] = None,
-                 pad_token_id: int = 0, seed: int = 0):
+                 pad_token_id: int = 0, seed: int = 0,
+                 num_beams: int = 1, length_penalty: float = 0.0):
         """Returns (generated_ids [B, max_new_tokens] Tensor,
         scores [B] cumulative logprob Tensor) — paddlenlp-shaped
-        (generated portion only, prompt excluded)."""
+        (generated portion only, prompt excluded).  ``beam_search``
+        runs the whole beam loop as ONE compiled program (beam-reorder
+        = cache gathers inside the scan); final scores are
+        sum-logprob / (length ** length_penalty)."""
         from ..tensor import Tensor
-        enforce(decode_strategy in ("greedy_search", "sampling"),
-                f"unsupported decode_strategy {decode_strategy!r} "
-                "(beam_search not yet implemented)")
+        enforce(decode_strategy in ("greedy_search", "sampling",
+                                    "beam_search"),
+                f"unsupported decode_strategy {decode_strategy!r}")
+        if decode_strategy == "beam_search":
+            enforce(num_beams >= 2,
+                    "beam_search needs num_beams >= 2")
         ids = np.asarray(input_ids.numpy() if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
         b, s = ids.shape
@@ -157,9 +166,12 @@ class GenerationMixin:
             max_new_tokens = max_length - s
         enforce(max_new_tokens > 0, "nothing to generate")
 
+        if decode_strategy != "beam_search":
+            num_beams, length_penalty = 1, 0.0   # unused: one engine
         key_static = (b, s, max_new_tokens, decode_strategy, int(top_k),
                       float(top_p), float(temperature), eos_token_id,
-                      int(pad_token_id))
+                      int(pad_token_id), int(num_beams),
+                      float(length_penalty))
         # bounded LRU: each (batch, prompt-len, ...) signature is a full
         # XLA compile of the decode loop — keep the last 8 only (serving
         # with highly variable prompt lengths should bucket/pad upstream)
@@ -178,7 +190,8 @@ class GenerationMixin:
         return Tensor(out_ids), Tensor(scores)
 
     def _build_gen_engine(self, b, s, max_new, strategy, top_k, top_p,
-                          temperature, eos_token_id, pad_token_id):
+                          temperature, eos_token_id, pad_token_id,
+                          num_beams=1, length_penalty=0.0):
         from ..autograd import tape
         from ..nn.layer import functional_state
         from ..tensor import Tensor
@@ -235,4 +248,98 @@ class GenerationMixin:
                 all_toks = tok[:, None]
             return all_toks, scores
 
+        def run_beam(params, ids, key):
+            """Whole-loop-compiled beam search with a finished-
+            hypotheses pool (the reference's BeamHypotheses contract):
+            a beam that emits EOS moves into the pool with its score
+            and length frozen; live beams never contain EOS, and the
+            final answer is the best length-penalized hypothesis across
+            pool + live.  Beams live as an expanded batch [b*K, ...];
+            the per-step beam reorder is a cache gather inside the
+            scan."""
+            K = num_beams
+            neg = jnp.float32(-1e30)
+            # prefill at batch b, then tile every cache row K times
+            caches = [StaticCache(c.k.value, c.v.value)
+                      for c in model.gen_static_caches(b, total)]
+            logits0, caches = fwd(params, ids, caches, jnp.int32(0),
+                                  prefill=True)
+            logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), -1)
+            if eos_token_id is not None:
+                logp0 = logp0.at[:, eos_token_id].set(neg)
+            scores, tok0 = jax.lax.top_k(logp0, K)       # [b, K]
+            caches = [StaticCache(jnp.repeat(c.k, K, axis=0),
+                                  jnp.repeat(c.v, K, axis=0))
+                      for c in caches]
+            hist = jnp.full((b, K, max_new), jnp.int32(pad_token_id))
+            hist = hist.at[:, :, 0].set(tok0)
+            barange = jnp.arange(b)[:, None]             # [b, 1]
+            # finished pool (scores at completion, penalized lengths)
+            pool_scores = jnp.full((b, K), neg)
+            pool_len = jnp.ones((b, K), jnp.float32)
+            pool_hist = jnp.full((b, K, max_new),
+                                 jnp.int32(pad_token_id))
+
+            def body(carry, t):
+                (tok, caches, pos, scores, hist, pool_scores, pool_len,
+                 pool_hist) = carry
+                flat_tok = tok.reshape(b * K)
+                logits, caches = fwd(params, flat_tok[:, None], caches,
+                                     pos)
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), -1).reshape(b, K, -1)
+                v = logp.shape[-1]
+                if eos_token_id is not None:
+                    # each live beam may finish NOW: candidate joins the
+                    # pool with score frozen at EOS emission
+                    eos_sc = scores + logp[:, :, eos_token_id]  # [b, K]
+                    eos_hist = hist.at[:, :, t].set(
+                        jnp.int32(eos_token_id))
+                    eos_len = jnp.full((b, K), jnp.float32(1.0)) * (
+                        t.astype(jnp.float32) + 1.0)
+                    all_sc = jnp.concatenate(
+                        [pool_scores, eos_sc], axis=1)          # [b,2K]
+                    all_len = jnp.concatenate([pool_len, eos_len], 1)
+                    all_hist = jnp.concatenate([pool_hist, eos_hist], 1)
+                    pool_scores, keep = jax.lax.top_k(all_sc, K)
+                    pool_len = all_len[barange, keep]
+                    pool_hist = all_hist[barange, keep]
+                    # live candidates never contain EOS
+                    logp = logp.at[:, :, eos_token_id].set(neg)
+                cand = scores[:, :, None] + logp         # [b, K, V]
+                scores, idx = jax.lax.top_k(cand.reshape(b, K * v), K)
+                beam_idx = idx // v                      # [b, K]
+                nxt = (idx % v).astype(jnp.int32)
+                hist = hist[barange, beam_idx]
+                hist = hist.at[:, :, t].set(nxt)
+                flat_idx = (barange * K + beam_idx).reshape(b * K)
+                caches = [StaticCache(c.k[flat_idx], c.v[flat_idx])
+                          for c in caches]
+                return (nxt, caches, pos + 1, scores, hist, pool_scores,
+                        pool_len, pool_hist), None
+
+            if max_new > 1:
+                carry = (tok0, caches, jnp.int32(s), scores, hist,
+                         pool_scores, pool_len, pool_hist)
+                (tok, _, _, scores, hist, pool_scores, pool_len,
+                 pool_hist), _ = jax.lax.scan(
+                    body, carry, jnp.arange(1, max_new))
+
+            live_len = jnp.full((b, K), jnp.float32(max_new))
+
+            def penalize(sc, ln):
+                if length_penalty == 0.0:
+                    return sc
+                return sc / (ln ** length_penalty)
+
+            final_sc = jnp.concatenate(
+                [penalize(pool_scores, pool_len),
+                 penalize(scores, live_len)], axis=1)    # [b, 2K]
+            final_hist = jnp.concatenate([pool_hist, hist], axis=1)
+            best = jnp.argmax(final_sc, axis=1)          # [b]
+            out = final_hist[jnp.arange(b), best]        # [b, max_new]
+            return out, final_sc[jnp.arange(b), best]
+
+        if strategy == "beam_search":
+            return jax.jit(run_beam)
         return jax.jit(run)

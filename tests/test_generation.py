@@ -89,3 +89,86 @@ class TestSampling:
         k1, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
                                decode_strategy="sampling", top_k=1, seed=3)
         np.testing.assert_array_equal(greedy.numpy(), k1.numpy())
+
+
+class TestBeamSearch:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(3)
+        m = LlamaForCausalLM(llama_tiny_config())
+        m.eval()
+        return m
+
+    def test_beam_search_exhaustive_width_finds_global_optimum(self):
+        """With num_beams == vocab and horizon 2, beam search IS
+        exhaustive — its result must equal the brute-force best
+        2-token continuation (computed from batched forwards)."""
+        import paddle_tpu as paddle
+        m = self._model()
+        vocab = 256
+        prompt = np.asarray([[5, 9, 2]], np.int32)
+        out, score = m.generate(paddle.to_tensor(prompt),
+                                max_new_tokens=2,
+                                decode_strategy="beam_search",
+                                num_beams=vocab)
+        out = np.asarray(out.numpy())[0]
+        score = float(np.asarray(score.numpy())[0])
+
+        # brute force: logp(tok1) for all tok1, + logp(tok2 | tok1)
+        base = np.asarray(
+            m(paddle.to_tensor(prompt.astype(np.int64))).numpy())[0, -1]
+        lp1 = base - base.max()
+        lp1 = lp1 - np.log(np.exp(lp1).sum())           # [V]
+        ext = np.concatenate(
+            [np.repeat(prompt, vocab, axis=0),
+             np.arange(vocab, dtype=np.int32)[:, None]], axis=1)
+        logits2 = np.asarray(
+            m(paddle.to_tensor(ext.astype(np.int64))).numpy())[:, -1]
+        l2 = logits2 - logits2.max(1, keepdims=True)
+        lp2 = l2 - np.log(np.exp(l2).sum(1, keepdims=True))  # [V, V]
+        total = lp1[:, None] + lp2                      # [tok1, tok2]
+        best = float(total.max())
+        np.testing.assert_allclose(score, best, atol=2e-3)
+        t1, t2 = np.unravel_index(total.argmax(), total.shape)
+        np.testing.assert_array_equal(out, [t1, t2])
+
+    def test_beam_search_eos_pool_freezes_hypothesis(self):
+        import paddle_tpu as paddle
+        m = self._model()
+        prompt = np.asarray([[5, 9, 2, 14]], np.int32)
+        out_g, _ = m.generate(paddle.to_tensor(prompt),
+                              max_new_tokens=6,
+                              decode_strategy="greedy_search")
+        eos = int(np.asarray(out_g.numpy())[0, 2])   # a plausible token
+        out, score = m.generate(paddle.to_tensor(prompt),
+                                max_new_tokens=6,
+                                decode_strategy="beam_search",
+                                num_beams=4, eos_token_id=eos,
+                                pad_token_id=0)
+        seq = np.asarray(out.numpy())[0].tolist()
+        if eos in seq:
+            i = seq.index(eos)
+            assert all(t == 0 for t in seq[i + 1:])   # frozen after eos
+        assert np.isfinite(float(np.asarray(score.numpy())[0]))
+
+    def test_beam_width_one_rejected(self):
+        import paddle_tpu as paddle
+        import pytest as _pytest
+        m = self._model()
+        with _pytest.raises(Exception):
+            m.generate(paddle.to_tensor(np.asarray([[1, 2]], np.int32)),
+                       decode_strategy="beam_search", num_beams=1)
+
+    def test_beam_search_batched_with_length_penalty(self):
+        import paddle_tpu as paddle
+        m = self._model()
+        prompt = np.asarray([[5, 9, 2], [7, 1, 3]], np.int32)
+        out, scores = m.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=4,
+                                 decode_strategy="beam_search",
+                                 num_beams=3, length_penalty=1.0)
+        assert tuple(out.shape) == (2, 4)
+        s = np.asarray(scores.numpy())
+        assert s.shape == (2,) and np.isfinite(s).all()
