@@ -56,6 +56,10 @@ class Qwen2MoeConfig:
     recompute: bool = False
     sequence_parallel: bool = False
     tie_word_embeddings: bool = False
+    # MoELayer dispatch: auto | dense | grouped | grouped_ep
+    moe_dispatch_mode: str = "auto"
+    # per-peer EP buffer bound (x balanced load); None = strict dropless
+    ep_capacity_factor: float | None = 2.0
 
     def as_llama(self) -> LlamaConfig:
         return LlamaConfig(
@@ -115,7 +119,9 @@ class Qwen2MoeDecoderLayer(Layer):
             init_std=c.initializer_range,
             num_layers_scale=c.num_hidden_layers,
             norm_topk_prob=c.norm_topk_prob,
-            use_shared_expert_gate=c.use_shared_expert_gate)
+            use_shared_expert_gate=c.use_shared_expert_gate,
+            dispatch_mode=c.moe_dispatch_mode,
+            ep_capacity_factor=c.ep_capacity_factor)
 
     def forward(self, x, cos_sin):
         x = x + self.self_attn(self.input_layernorm(x), cos_sin)

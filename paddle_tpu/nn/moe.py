@@ -39,11 +39,15 @@ __all__ = ["TopKGate", "ExpertFFN", "MoELayer", "moe_dispatch_combine"]
 EP_AXES = ("ep", "dp", "sharding")
 
 
-def _router_topk(x, wg, *, k, balance_coef, z_coef, norm_topk=True):
-    """Shared router math: x [T,H], wg [H,E] -> gate_vals [T,k] (f32),
-    expert_idx [T,k] (int32), aux_loss (scalar).  ``norm_topk``
-    renormalises the top-k gate values (Mixtral convention; HF
-    Qwen2-MoE ships norm_topk_prob=False)."""
+def _router_parts(x, wg, *, k, norm_topk=True):
+    """Router math split into combinable parts: x [T,H], wg [H,E] ->
+    gate_vals [T,k] (f32), expert_idx [T,k] (int32), plus the per-token
+    MEANS the aux loss is assembled from (density [E], density_proxy
+    [E], zsq scalar).  Means over equal-size token shards average to the
+    global mean, so the EP path reconstructs the exact global aux with a
+    ``pmean`` over the expert fold.  ``norm_topk`` renormalises the
+    top-k gate values (Mixtral convention; HF Qwen2-MoE ships
+    norm_topk_prob=False)."""
     e = wg.shape[1]
     logits = jnp.dot(x.astype(jnp.float32), wg.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)                  # [T, E]
@@ -53,16 +57,32 @@ def _router_topk(x, wg, *, k, balance_coef, z_coef, norm_topk=True):
         gate_vals = gate_vals / jnp.clip(
             jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
-    # aux load-balance loss over the FULL top-k assignment density (the
+    # aux load-balance parts over the FULL top-k assignment density (the
     # reference's top-k gates count every selected slot, not just slot 0 —
     # ADVICE.md round-1): fraction of routed slots landing on each expert
     topk_onehot = jax.nn.one_hot(expert_idx, e)              # [T, k, E]
     density = jnp.mean(jnp.sum(topk_onehot, axis=1), axis=0) / k
     density_proxy = jnp.mean(probs, axis=0)
+    zsq = jnp.mean(jnp.square(
+        jax.scipy.special.logsumexp(logits, axis=-1)))
+    return gate_vals, expert_idx, density, density_proxy, zsq
+
+
+def _assemble_aux(density, density_proxy, zsq, *, balance_coef, z_coef):
+    e = density.shape[0]
     aux = balance_coef * e * jnp.sum(density * density_proxy)
     if z_coef:
-        aux = aux + z_coef * jnp.mean(
-            jnp.square(jax.scipy.special.logsumexp(logits, axis=-1)))
+        aux = aux + z_coef * zsq
+    return aux
+
+
+def _router_topk(x, wg, *, k, balance_coef, z_coef, norm_topk=True):
+    """Shared router math: x [T,H], wg [H,E] -> gate_vals [T,k] (f32),
+    expert_idx [T,k] (int32), aux_loss (scalar)."""
+    gate_vals, expert_idx, density, proxy, zsq = _router_parts(
+        x, wg, k=k, norm_topk=norm_topk)
+    aux = _assemble_aux(density, proxy, zsq, balance_coef=balance_coef,
+                        z_coef=z_coef)
     return gate_vals, expert_idx, aux
 
 
@@ -213,13 +233,16 @@ class MoELayer(Layer):
                  dispatch_mode: str = "auto",
                  group_tile: Optional[int] = None,
                  norm_topk_prob: bool = True,
-                 use_shared_expert_gate: bool = False):
+                 use_shared_expert_gate: bool = False,
+                 ep_capacity_factor: Optional[float] = 2.0):
         super().__init__()
         from ..common.errors import enforce
-        enforce(dispatch_mode in ("auto", "dense", "grouped"),
+        enforce(dispatch_mode in ("auto", "dense", "grouped",
+                                  "grouped_ep"),
                 f"bad dispatch_mode {dispatch_mode!r}")
         self.dispatch_mode = dispatch_mode
         self.group_tile = group_tile
+        self.ep_capacity_factor = ep_capacity_factor
         self.gate = gate or TopKGate(
             hidden_size, num_experts, k=k, capacity_factor=capacity_factor,
             balance_loss_weight=balance_loss_weight,
@@ -252,22 +275,46 @@ class MoELayer(Layer):
             self.shared_expert_gate = None
         self.aux_loss: Optional[Tensor] = None
 
-    def _resolve_dispatch(self) -> str:
+    def _resolve_dispatch(self, num_tokens: int) -> str:
         """'grouped' (dropless Pallas) on a single chip / unsharded
-        experts on TPU; 'dense' (GShard einsums → GSPMD all-to-alls)
-        whenever the expert dim is sharded or off-TPU.  Resolved at
-        trace time — mesh state and backend are static then."""
-        if self.dispatch_mode != "auto":
-            return self.dispatch_mode
-        if not (isinstance(self.gate, TopKGate)
-                and isinstance(self.experts, ExpertFFN)):
+        experts on TPU; 'grouped_ep' (shard_map all-to-all + per-shard
+        grouped matmul) when the expert fold is active on TPU; 'dense'
+        (GShard einsums → GSPMD all-to-alls) off-TPU or when shapes
+        don't divide the fold.  Resolved at trace time — mesh state and
+        backend are static then."""
+        mode = self.dispatch_mode
+        custom = not (isinstance(self.gate, TopKGate)
+                      and isinstance(self.experts, ExpertFFN))
+        if mode == "auto" and custom:
             return "dense"
         from ..distributed.auto_parallel import get_mesh
         pm = get_mesh()
-        # any sharding touching the expert weights (expert dim over the
-        # EP axes, F dim over mp) needs the GSPMD-partitionable einsums
-        if pm is not None and any(
-                pm.mesh.shape.get(a, 1) > 1 for a in EP_AXES + ("mp",)):
+        fold = 1
+        if pm is not None:
+            from ..distributed.expert_parallel import expert_fold_axes
+            fold = int(np.prod([pm.mesh.shape[a]
+                                for a in expert_fold_axes(pm.mesh)],
+                               dtype=np.int64))
+        if mode == "grouped_ep" or (mode == "auto" and fold > 1):
+            e = self.gate.num_experts
+            divisible = (fold > 1 and e % fold == 0
+                         and num_tokens % fold == 0)
+            if mode == "grouped_ep":
+                from ..common.errors import enforce
+                enforce(divisible,
+                        f"grouped_ep needs experts ({e}) and tokens "
+                        f"({num_tokens}) divisible by the expert fold "
+                        f"({fold})")
+                return "grouped_ep"
+            import jax as _jax
+            if divisible and _jax.default_backend() == "tpu":
+                return "grouped_ep"
+            return "dense"
+        if mode != "auto":
+            return mode
+        # mp-only sharding (no expert fold): the F dim is tensor-sharded
+        # — keep the GSPMD-partitionable einsums
+        if pm is not None and pm.mesh.shape.get("mp", 1) > 1:
             return "dense"
         import jax as _jax
         return "grouped" if _jax.default_backend() == "tpu" else "dense"
@@ -275,8 +322,21 @@ class MoELayer(Layer):
     def forward(self, x):
         b, s, h = x.shape
         flat = apply_op(lambda a: a.reshape(b * s, h), x)
-        mode = self._resolve_dispatch()
-        if mode == "grouped":
+        mode = self._resolve_dispatch(b * s)
+        if mode == "grouped_ep":
+            from ..distributed.auto_parallel import get_mesh
+            from ..distributed.expert_parallel import moe_grouped_ep_raw
+            out, aux = apply_op(
+                moe_grouped_ep_raw, flat, self.gate.weight,
+                self.experts.gate_w, self.experts.up_w,
+                self.experts.down_w, k=self.gate.k,
+                balance_coef=self.gate.balance_loss_weight,
+                z_coef=self.gate.z_loss_weight, tm=self.group_tile,
+                interpret=jax.default_backend() != "tpu",
+                norm_topk=self.gate.norm_topk_prob,
+                mesh=get_mesh().mesh,
+                capacity_factor=self.ep_capacity_factor)
+        elif mode == "grouped":
             out, aux = apply_op(
                 _moe_grouped_raw, flat, self.gate.weight,
                 self.experts.gate_w, self.experts.up_w,

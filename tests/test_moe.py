@@ -260,6 +260,133 @@ def test_moe_ep_axis_sharded_train_step():
     assert "ep" in str(ew.sharding.spec)
 
 
+def _ep_mesh(ep=2, dp=2, mp=2):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": ep}
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_moe_layer_grouped_ep_matches_dense_mode():
+    """grouped_ep (shard_map EP all-to-all + per-shard grouped matmul)
+    equals the ample-capacity dense path on an active ep mesh —
+    including the aux loss (reassembled exactly via fold-pmean)."""
+    _ep_mesh()
+    rng = np.random.default_rng(7)
+    b, s, h, e, f, k = 2, 16, 16, 8, 32, 2
+    dense = MoELayer(h, e, f, k=k, capacity_factor=float(e),
+                     dispatch_mode="dense")
+    ep = MoELayer(h, e, f, k=k, dispatch_mode="grouped_ep",
+                  group_tile=8, gate=dense.gate, experts=dense.experts,
+                  ep_capacity_factor=None)  # strict dropless for parity
+    x = paddle.to_tensor(
+        rng.standard_normal((b, s, h)).astype(np.float32))
+    out_d = dense(x)
+    out_e = ep(x)
+    # per-shard grouped kernel dots round to bf16 (interpret-mode MXU
+    # semantics); dense einsums run f32 — bf16-scale tolerance
+    np.testing.assert_allclose(np.asarray(out_e.numpy()),
+                               np.asarray(out_d.numpy()), atol=5e-3,
+                               rtol=2e-2)
+    np.testing.assert_allclose(float(ep.aux_loss.numpy()),
+                               float(dense.aux_loss.numpy()), rtol=1e-5)
+
+
+def test_moe_grouped_ep_raw_grads_match_single_chip_grouped():
+    """The EP path is the same function as the single-chip grouped path
+    — forward AND gradients (all-to-alls + scatter/gather transpose
+    correctly through shard_map AD)."""
+    from paddle_tpu.distributed.auto_parallel import get_mesh
+    from paddle_tpu.distributed.expert_parallel import moe_grouped_ep_raw
+    from paddle_tpu.nn.moe import _moe_grouped_raw
+    _ep_mesh()
+    mesh = get_mesh().mesh
+    rng = np.random.default_rng(8)
+    t, h, e, f, k = 32, 16, 8, 32, 2
+    x = _bf16r(rng.standard_normal((t, h)))
+    rw = _bf16r(rng.standard_normal((h, e)) * 0.3)
+    wg = _bf16r(rng.standard_normal((e, h, f)) * 0.05)
+    wu = _bf16r(rng.standard_normal((e, h, f)) * 0.05)
+    wd = _bf16r(rng.standard_normal((e, f, h)) * 0.05)
+
+    def loss_ep(x, rw, wg, wu, wd):
+        out, aux = moe_grouped_ep_raw(
+            x, rw, wg, wu, wd, k=k, balance_coef=0.01, z_coef=1e-3,
+            norm_topk=True, tm=8, interpret=True, mesh=mesh,
+            capacity_factor=None)  # strict dropless for parity
+        return (out.astype(jnp.float32) ** 2).sum() + aux
+
+    def loss_sc(x, rw, wg, wu, wd):
+        out, aux = _moe_grouped_raw(
+            x, rw, wg, wu, wd, k=k, balance_coef=0.01, z_coef=1e-3,
+            tm=8, interpret=True, norm_topk=True)
+        return (out.astype(jnp.float32) ** 2).sum() + aux
+
+    le = float(loss_ep(x, rw, wg, wu, wd))
+    ls = float(loss_sc(x, rw, wg, wu, wd))
+    np.testing.assert_allclose(le, ls, rtol=1e-4)
+    ge = jax.grad(loss_ep, argnums=(0, 1, 2, 3, 4))(x, rw, wg, wu, wd)
+    gs = jax.grad(loss_sc, argnums=(0, 1, 2, 3, 4))(x, rw, wg, wu, wd)
+    for a, b_ in zip(ge, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-3, rtol=2e-2)
+
+
+def test_moe_grouped_ep_capacity_drop_stays_finite():
+    """A sub-dropless capacity factor drops overflow tokens (their
+    combine contribution is zero) instead of corrupting neighbours."""
+    from paddle_tpu.distributed.auto_parallel import get_mesh
+    from paddle_tpu.distributed.expert_parallel import moe_grouped_ep_raw
+    _ep_mesh()
+    mesh = get_mesh().mesh
+    rng = np.random.default_rng(9)
+    t, h, e, f, k = 32, 16, 8, 16, 2
+    x = _bf16r(rng.standard_normal((t, h)))
+    rw = _bf16r(rng.standard_normal((h, e)) * 0.3)
+    wg = _bf16r(rng.standard_normal((e, h, f)) * 0.05)
+    wu = _bf16r(rng.standard_normal((e, h, f)) * 0.05)
+    wd = _bf16r(rng.standard_normal((e, f, h)) * 0.05)
+    out, aux = moe_grouped_ep_raw(
+        x, rw, wg, wu, wd, k=k, balance_coef=0.01, z_coef=0.0,
+        norm_topk=True, tm=8, interpret=True, mesh=mesh,
+        capacity_factor=0.5)
+    assert out.shape == (t, h)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+    assert np.isfinite(float(aux))
+
+
+def test_moe_ep_grouped_sharded_train_step():
+    """Forced grouped_ep through the full sharded training step on the
+    dedicated ep axis: loss decreases, expert weights stay ep-sharded —
+    the round-3 gap (grouped path vanished under ep>1) closed."""
+    from paddle_tpu.distributed.trainer import ShardedTrainStep
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             qwen2_moe_tiny_config)
+    _ep_mesh()
+    cfg = qwen2_moe_tiny_config()
+    cfg.moe_dispatch_mode = "grouped_ep"
+    model = Qwen2MoeForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(m, b):
+        return m(b["input_ids"], labels=b["labels"])
+
+    step = ShardedTrainStep(model, loss_fn, opt, stage=1)
+    rng = np.random.default_rng(10)
+    ids = rng.integers(0, cfg.vocab_size, size=(4, 16), dtype=np.int64)
+    labels = np.concatenate(
+        [ids[:, 1:], np.full((4, 1), -100, np.int64)], axis=1)
+    batch = {"input_ids": ids, "labels": labels}
+    losses = [float(np.asarray(jax.device_get(step(batch))))
+              for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    ew = step.state["params"]["layers.0.mlp.experts.gate_w"]
+    assert "ep" in str(ew.sharding.spec)
+
+
 def test_deepseek_moe_class_many_experts_grouped_path():
     """DeepSeekMoE-class geometry: 64 fine-grained experts top-6 — the
     grouped path's adaptive tile bounds per-expert padding and the
