@@ -47,15 +47,30 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_grouped_ep_raw", "expert_fold_axes", "EP_FOLD"]
+__all__ = ["moe_grouped_ep_raw", "expert_fold_axes",
+           "ep_grouped_compatible", "EP_FOLD"]
 
-# expert-dim fold order — must match nn.moe.EP_AXES
-EP_FOLD = ("ep", "dp", "sharding")
+# single source of the expert-dim fold order (this module loads lazily
+# from MoELayer.forward, after nn.moe is fully imported)
+from ..nn.moe import EP_AXES as EP_FOLD  # noqa: E402
 
 
 def expert_fold_axes(mesh) -> Tuple[str, ...]:
     """Mesh axes (>1) the expert dim folds over, in fold order."""
     return tuple(a for a in EP_FOLD if mesh.shape.get(a, 1) > 1)
+
+
+def ep_grouped_compatible(mesh, num_experts: int,
+                          num_tokens: int) -> bool:
+    """True when the grouped EP path can run: an active expert fold
+    whose size divides both the expert count and the token count.  The
+    ONE divisibility predicate shared by MoELayer._resolve_dispatch and
+    the dryrun's forced-mode gate."""
+    fold = expert_fold_axes(mesh)
+    if not fold:
+        return False
+    n = int(np.prod([mesh.shape[a] for a in fold]))
+    return n > 1 and num_experts % n == 0 and num_tokens % n == 0
 
 
 def _fused_index(fold: Tuple[str, ...], sizes: Tuple[int, ...]):
@@ -158,8 +173,9 @@ def moe_grouped_ep_raw(x, router_w, wg, wu, wd, *, k, balance_coef,
     ``factor * slots / fold`` rows per peer (see module docstring);
     ``None`` means strictly dropless (full slot count per shard).
 
-    Raises NotImplementedError when no expert fold axis is active or
-    shapes don't divide — callers fall back to the dense GShard path.
+    Callers must pre-check :func:`ep_grouped_compatible` (MoELayer's
+    dispatch resolution does); the NotImplementedErrors below are the
+    backstop for direct raw-level misuse.
     """
     fold = expert_fold_axes(mesh)
     if not fold:

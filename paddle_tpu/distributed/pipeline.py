@@ -261,7 +261,8 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
 @functools.lru_cache(maxsize=64)
 def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                  pp_axis: str, n_params: int, n_extra: int,
-                 n_tail_params: int, n_tail_idx: int):
+                 n_tail_params: int, n_tail_idx: int,
+                 stash: bool = False):
     """The fused 1F1B loop (fleet PipelineParallel.train_batch's
     schedule, compiled): at tick t, stage s runs forward on microbatch
     ``t - s`` and backward on microbatch ``t - (2S-1) + s``.  Stage
@@ -270,6 +271,20 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
     — independent of n_micro.  Gradients come from per-tick jax.vjp at
     the saved inputs (no AD through the loop, so lax.cond may skip
     inactive ramp ticks and the per-stage branch on every backend).
+
+    ``stash=False`` (remat schedule): the ring holds stage INPUTS and
+    every backward tick re-runs the stage forward inside jax.vjp —
+    minimal memory (2S input slots), ~1 extra forward of FLOPs per
+    microbatch.  ``stash=True`` (the reference 1F1B's memory/compute
+    point — fleet PipelineParallel saves in-flight activations): the
+    forward tick runs jax.vjp and the ring holds the VJP RESIDUALS
+    (weight leaves are filtered by tracer identity and re-injected at
+    backward, so parameters are never duplicated per slot); backward
+    ticks apply the saved vjp — no recompute.  Residual size per slot
+    is whatever ``stage_fn``'s own checkpoint policy leaves saveable,
+    so model-level recompute flags still control the memory/FLOPs
+    trade inside a stage.  Both rings are 2S slots — memory stays
+    ∝ pp either way.
 
     Returns (loss_sum, count, grads_stacked, dxm, grads_tail) with the
     grads UNSCALED (cotangent 1.0 on loss_sum); the custom_vjp wrapper
@@ -318,10 +333,41 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
         # Varying inputs keep cotangents device-local; the single psum
         # at the end does the cross-stage reduction.
         tail_params = tuple(_pvary(t, pp_axis) for t in tail_params)
+        const_pool = list(locals_) + list(extra)
+        box: dict = {}
+        if stash:
+            # trace-time probe: residual shapes + which leaves are just
+            # re-reads of the (tick-invariant) weights/extras — those
+            # are re-injected at backward instead of ring-buffered
+            def _probe(ip):
+                _, vjp = jax.vjp(lambda ch, i: stage_fn(ch, i, *extra),
+                                 locals_, ip)
+                flat, _ = jax.tree_util.tree_flatten(vjp)
+                box["const_ix"] = [
+                    next((j for j, c in enumerate(const_pool)
+                          if l is c), -1) for l in flat]
+                box["res_sd"] = [(tuple(l.shape), l.dtype)
+                                 for l in flat]
+                return 0
+
+            # probe with zero_act (not the act template): its aval
+            # carries the {pp} varying annotation the scan carries need
+            jax.eval_shape(_probe, zero_act)
+            const_ix = box["const_ix"]
+            ring0 = (
+                tuple(_pvary(jnp.zeros((ring_n,) + sh, dt), pp_axis)
+                      for (sh, dt), ci in zip(box["res_sd"], const_ix)
+                      if ci < 0),
+                _pvary(jnp.zeros((ring_n,) + act.shape, act.dtype),
+                       pp_axis),                             # stage outs
+            )
+        else:
+            ring0 = _pvary(jnp.zeros((ring_n,) + act.shape, act.dtype),
+                           pp_axis)                          # stage inputs
         state = (
             zero_act,                                        # fwd carry
             zero_act,                                        # bwd carry
-            _pvary(jnp.zeros((ring_n,) + act.shape, act.dtype), pp_axis),
+            ring0,
             tuple(_pvary(jnp.zeros(c.shape, jnp.float32), pp_axis)
                   for c in locals_),                         # param grads
             tuple(_pvary(jnp.zeros(t.shape, jnp.float32), pp_axis)
@@ -340,11 +386,29 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             mfc = jnp.clip(mf, 0, n_micro - 1)
             inp = jnp.where(stage == 0, xmv[mfc], fcarry)
 
-            def do_f(ring):
-                y = fwd_fn(locals_, inp)
-                ring = jax.lax.dynamic_update_index_in_dim(
-                    ring, inp, mfc % ring_n, 0)
-                return y, ring
+            if stash:
+                def do_f(rs):
+                    res_rings, y_ring = rs
+                    y, vjp = jax.vjp(
+                        lambda ch, i: fwd_fn(ch, i), locals_, inp)
+                    flat, td = jax.tree_util.tree_flatten(vjp)
+                    box["td"] = td
+                    slot = mfc % ring_n
+                    stored = [l for l, ci in zip(flat, const_ix)
+                              if ci < 0]
+                    res_rings = tuple(
+                        jax.lax.dynamic_update_index_in_dim(
+                            r, v_, slot, 0)
+                        for r, v_ in zip(res_rings, stored))
+                    y_ring = jax.lax.dynamic_update_index_in_dim(
+                        y_ring, y, slot, 0)
+                    return y, (res_rings, y_ring)
+            else:
+                def do_f(ring):
+                    y = fwd_fn(locals_, inp)
+                    ring = jax.lax.dynamic_update_index_in_dim(
+                        ring, inp, mfc % ring_n, 0)
+                    return y, ring
 
             y, ring = _branch(
                 active_f, do_f, lambda ring: (inp, ring), ring)
@@ -353,19 +417,44 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             mb = t - (2 * s_count - 1) + stage
             active_b = (mb >= 0) & (mb < n_micro)
             mbc = jnp.clip(mb, 0, n_micro - 1)
-            sinp = ring[mbc % ring_n]
+            slot_b = mbc % ring_n
+            sinp = None if stash else ring[slot_b]
+
+            def _apply_saved_vjp(ct):
+                """Rebuild the forward tick's vjp from ring residuals +
+                re-injected constant leaves and apply it (stash mode)."""
+                res_rings, _ = ring
+                stored_b = [jax.lax.dynamic_index_in_dim(r, slot_b, 0,
+                                                         False)
+                            for r in res_rings]
+                it = iter(stored_b)
+                re_flat = [const_pool[ci] if ci >= 0 else next(it)
+                           for ci in const_ix]
+                vjp_saved = jax.tree_util.tree_unflatten(box["td"],
+                                                         re_flat)
+                return vjp_saved(ct)
+
+            def seed(p, fill):
+                ct = jnp.full(p.shape, fill, p.dtype)
+                if pp_axis in getattr(_typeof(p), "vma", ()):
+                    ct = _pvary(ct, pp_axis)
+                return ct
 
             def bwd_last(_):
                 lbls = tuple(ti[mbc] for ti in tail_idx_v)
-                (s_, c_), vjp = jax.vjp(
-                    lambda ch, ip, tp: last_fn(ch, ip, tp, lbls),
-                    locals_, sinp, tuple(tail_params))
-                def seed(p, fill):
-                    ct = jnp.full(p.shape, fill, p.dtype)
-                    if pp_axis in getattr(_typeof(p), "vma", ()):
-                        ct = _pvary(ct, pp_axis)
-                    return ct
-                dch, dip, dtp = vjp((seed(s_, 1.0), seed(c_, 0.0)))
+                if stash:
+                    y_saved = jax.lax.dynamic_index_in_dim(
+                        ring[1], slot_b, 0, False)
+                    (s_, c_), tvjp = jax.vjp(
+                        lambda tp, yy: tail_fn(tp, yy, *lbls),
+                        tuple(tail_params), y_saved)
+                    dtp, dy = tvjp((seed(s_, 1.0), seed(c_, 0.0)))
+                    dch, dip = _apply_saved_vjp(dy)
+                else:
+                    (s_, c_), vjp = jax.vjp(
+                        lambda ch, ip, tp: last_fn(ch, ip, tp, lbls),
+                        locals_, sinp, tuple(tail_params))
+                    dch, dip, dtp = vjp((seed(s_, 1.0), seed(c_, 0.0)))
                 # cotangents of replicated (unvaried) inputs come back
                 # unvaried — align vma/pytree with the other branches
                 dch = tuple(_pvary(g, pp_axis) for g in dch)
@@ -376,9 +465,12 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                         _pvary(c_.astype(jnp.float32), pp_axis))
 
             def bwd_mid(_):
-                _, vjp = jax.vjp(
-                    lambda ch, ip: fwd_fn(ch, ip), locals_, sinp)
-                dch, dip = vjp(bcarry)
+                if stash:
+                    dch, dip = _apply_saved_vjp(bcarry)
+                else:
+                    _, vjp = jax.vjp(
+                        lambda ch, ip: fwd_fn(ch, ip), locals_, sinp)
+                    dch, dip = vjp(bcarry)
                 dch = tuple(_pvary(g, pp_axis) for g in dch)
                 zt = tuple(_pvary(jnp.zeros(t.shape, t.dtype), pp_axis)
                            for t in tail_params)
@@ -439,19 +531,21 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
     return jax.jit(mapped)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 9))
 def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
-                        x_micro, extra, tail_params, tail_indexed):
+                        x_micro, extra, tail_params, tail_indexed,
+                        stash: bool = False):
     """Mean loss of the pipelined model+loss-head under the 1F1B
     schedule.  ``tail_fn`` must return ``(loss_sum, valid_count)``; the
     result is Σloss_sum / max(Σcount, 1) over all microbatches.
 
     Differentiable via custom_vjp: under jax.grad the fwd rule runs the
     fused 1F1B loop ONCE, producing loss and all gradients together
-    (stage-input ring buffer ⇒ activation memory ∝ pp, not n_micro);
-    without grad, the plain forward pipeline runs (cond-guarded tail).
+    (ring buffers ⇒ activation memory ∝ pp, not n_micro); without grad,
+    the plain forward pipeline runs (cond-guarded tail).
     stacked: tuple of [S, per_chunk, ...] arrays (global chunk order,
-    n_virtual==1)."""
+    n_virtual==1).  ``stash``: ring-buffer VJP residuals so backward
+    ticks skip the forward recompute (see _jitted_1f1b)."""
     loss_sum, count = gpipe_spmd(
         list(stacked), x_micro, stage_fn, *extra, mesh=mesh,
         pp_axis=pp_axis, n_virtual=1, tail_fn=tail_fn,
@@ -461,9 +555,11 @@ def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
 
 
 def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
-                     extra, tail_params, tail_indexed):
+                     extra, tail_params, tail_indexed,
+                     stash: bool = False):
     eng = _jitted_1f1b(stage_fn, tail_fn, mesh, pp_axis, len(stacked),
-                       len(extra), len(tail_params), len(tail_indexed))
+                       len(extra), len(tail_params), len(tail_indexed),
+                       stash)
     lsum, cnt, gp, dxm, gt = eng(tuple(stacked), x_micro, *extra,
                                  *tail_params, *tail_indexed)
     denom = jnp.maximum(cnt, 1.0)
@@ -476,7 +572,7 @@ def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
     return loss, (gp, dxm, gt, denom)
 
 
-def _ptrain_1f1b_bwd(stage_fn, tail_fn, mesh, pp_axis, res, ct):
+def _ptrain_1f1b_bwd(stage_fn, tail_fn, mesh, pp_axis, stash, res, ct):
     gp, dxm, gt, denom = res
     scale = ct / denom
     dstacked = tuple((g * scale).astype(g.dtype) for g in gp)

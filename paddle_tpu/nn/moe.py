@@ -290,19 +290,21 @@ class MoELayer(Layer):
         from ..distributed.auto_parallel import get_mesh
         pm = get_mesh()
         fold = 1
+        divisible = False
         if pm is not None:
-            from ..distributed.expert_parallel import expert_fold_axes
+            from ..distributed.expert_parallel import (
+                ep_grouped_compatible, expert_fold_axes)
             fold = int(np.prod([pm.mesh.shape[a]
                                 for a in expert_fold_axes(pm.mesh)],
                                dtype=np.int64))
+            divisible = ep_grouped_compatible(
+                pm.mesh, self.gate.num_experts, num_tokens)
         if mode == "grouped_ep" or (mode == "auto" and fold > 1):
-            e = self.gate.num_experts
-            divisible = (fold > 1 and e % fold == 0
-                         and num_tokens % fold == 0)
             if mode == "grouped_ep":
                 from ..common.errors import enforce
                 enforce(divisible,
-                        f"grouped_ep needs experts ({e}) and tokens "
+                        f"grouped_ep needs experts "
+                        f"({self.gate.num_experts}) and tokens "
                         f"({num_tokens}) divisible by the expert fold "
                         f"({fold})")
                 return "grouped_ep"
