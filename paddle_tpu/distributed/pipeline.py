@@ -262,7 +262,7 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
 def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                  pp_axis: str, n_params: int, n_extra: int,
                  n_tail_params: int, n_tail_idx: int,
-                 stash: bool = False):
+                 stash: bool = False, n_virtual: int = 1):
     """The fused 1F1B loop (fleet PipelineParallel.train_batch's
     schedule, compiled): at tick t, stage s runs forward on microbatch
     ``t - s`` and backward on microbatch ``t - (2S-1) + s``.  Stage
@@ -271,6 +271,19 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
     — independent of n_micro.  Gradients come from per-tick jax.vjp at
     the saved inputs (no AD through the loop, so lax.cond may skip
     inactive ramp ticks and the per-stage branch on every backend).
+
+    ``n_virtual = v > 1`` is the INTERLEAVED 1F1B (Megatron virtual
+    pipeline, fleet's interleaved schedule): device d owns chunks
+    d, d+S, …, d+(v-1)S; microbatches run in rounds of S per lap.
+    Forward of chunk c = lap·S + d on microbatch m = r·S + j fires at
+    tick t = r·vS + lap·S + j + d; backward mirrors it with delay
+    D = vS at t = D + r·vS + (v-1-lap)·S + j + (S-1-d) — the mirror
+    keeps every producer exactly one tick ahead of its consumer
+    (chain gap 1 at the loss chunk, ring gap < 2vS everywhere, both
+    provable from the algebra), so the ring needs 2vS CHUNK slots —
+    each 1/v of a stage, i.e. the same total bytes as v=1's 2S stage
+    slots: memory stays ∝ pp.  Fill+drain bubble shrinks from 2S-1
+    stage-units (v=1) to S + (S-1)/v.
 
     ``stash=False`` (remat schedule): the ring holds stage INPUTS and
     every backward tick re-runs the stage forward inside jax.vjp —
@@ -304,17 +317,29 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
         return jax.tree_util.tree_map(
             lambda a, b: jnp.where(pred, a, b), t, f)
 
+    v = n_virtual
+    enforce(not (stash and v > 1),
+            "stash-residual 1F1B requires n_virtual == 1 (weight-leaf "
+            "identity filtering needs tick-invariant chunk tracers)")
+
     def inner(params_local, xm, *rest):
         extra = rest[:n_extra]
         tail_params = rest[n_extra:n_extra + n_tail_params]
         tail_idx = rest[n_extra + n_tail_params:]
-        locals_ = [p[0] for p in params_local]      # [per_chunk, ...]
+        # v==1: local slab [1, per, ...] -> [per, ...]
+        # v>1:  local slab [1, v, per, ...] -> [v, per, ...] (lap dim)
+        locals_ = [p[0] for p in params_local]
         n_micro = xm.shape[0]
         stage = jax.lax.axis_index(pp_axis)
         s_count = nstage
-        ring_n = 2 * s_count
-        total = n_micro + 2 * s_count - 1
+        rounds = -(-n_micro // s_count)             # ceil, v>1 rounds
+        ring_n = 2 * v * s_count
+        span = rounds * v * s_count                 # F-tick count (v>1)
+        total = (n_micro + 2 * s_count - 1) if v == 1 \
+            else (span + v * s_count + s_count - 1)
         is_last = stage == s_count - 1
+        chunk_shapes = [tuple(p.shape[(2 if v > 1 else 1):])
+                        for p in params_local]
 
         def fwd_fn(chunk, inp):
             return stage_fn(chunk, inp, *extra)
@@ -354,6 +379,28 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             # carries the {pp} varying annotation the scan carries need
             jax.eval_shape(_probe, zero_act)
             const_ix = box["const_ix"]
+            # identity filtering is heuristic (vjp residual leaves that
+            # ARE the weight tracers) — if it matched nothing, the full
+            # weight set would be ring-buffered 2S times per device.
+            # Make that degradation loud instead of a silent HBM blowup.
+            import numpy as _np
+            stored_b = sum(
+                int(_np.prod(sh)) * _np.dtype(dt).itemsize
+                for (sh, dt), ci in zip(box["res_sd"], const_ix)
+                if ci < 0)
+            act_b = int(_np.prod(act.shape)) * _np.dtype(
+                act.dtype).itemsize
+            weight_b = sum(int(_np.prod(c.shape)) * _np.dtype(
+                c.dtype).itemsize for c in locals_)
+            if all(ci < 0 for ci in const_ix) and \
+                    stored_b > 4 * act_b + weight_b:
+                import warnings
+                warnings.warn(
+                    "1F1B stash: no vjp residual leaf matched a weight "
+                    f"tracer; ring-buffering {stored_b >> 20} MiB per "
+                    "slot (includes per-slot weight copies). Set "
+                    "stash=False or simplify the stage fn.",
+                    RuntimeWarning, stacklevel=2)
             ring0 = (
                 tuple(_pvary(jnp.zeros((ring_n,) + sh, dt), pp_axis)
                       for (sh, dt), ci in zip(box["res_sd"], const_ix)
@@ -380,11 +427,33 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
         def step(t, st):
             fcarry, bcarry, ring, gp, gt, dxm, lsum, cnt = st
 
-            # ---- forward: F_s(m) at t = m + s --------------------------
-            mf = t - stage
-            active_f = (mf >= 0) & (mf < n_micro)
-            mfc = jnp.clip(mf, 0, n_micro - 1)
-            inp = jnp.where(stage == 0, xmv[mfc], fcarry)
+            # ---- forward ------------------------------------------------
+            if v == 1:
+                # F_s(m) at t = m + s
+                mf = t - stage
+                active_f = (mf >= 0) & (mf < n_micro)
+                mfc = jnp.clip(mf, 0, n_micro - 1)
+                slot_f = mfc % ring_n
+                lap_f = jnp.zeros((), t.dtype)
+                chunk_f = locals_
+                feed_f = stage == 0
+            else:
+                # interleaved: F of chunk lap·S+d on microbatch r·S+j at
+                # t = r·vS + lap·S + j + d  (device tick u = t - d)
+                uf = t - stage
+                ufc = jnp.clip(uf, 0, span - 1)
+                r_f = ufc // (v * s_count)
+                q_f = ufc % (v * s_count)
+                lap_f = q_f // s_count
+                mf = r_f * s_count + q_f % s_count
+                active_f = (uf >= 0) & (uf < span) & (mf < n_micro)
+                mfc = jnp.clip(mf, 0, n_micro - 1)
+                slot_f = ufc % ring_n
+                chunk_f = [jax.lax.dynamic_index_in_dim(p, lap_f, 0,
+                                                        False)
+                           for p in locals_]
+                feed_f = (stage == 0) & (lap_f == 0)
+            inp = jnp.where(feed_f, xmv[mfc], fcarry)
 
             if stash:
                 def do_f(rs):
@@ -393,31 +462,54 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                         lambda ch, i: fwd_fn(ch, i), locals_, inp)
                     flat, td = jax.tree_util.tree_flatten(vjp)
                     box["td"] = td
-                    slot = mfc % ring_n
                     stored = [l for l, ci in zip(flat, const_ix)
                               if ci < 0]
                     res_rings = tuple(
                         jax.lax.dynamic_update_index_in_dim(
-                            r, v_, slot, 0)
+                            r, v_, slot_f, 0)
                         for r, v_ in zip(res_rings, stored))
                     y_ring = jax.lax.dynamic_update_index_in_dim(
-                        y_ring, y, slot, 0)
+                        y_ring, y, slot_f, 0)
                     return y, (res_rings, y_ring)
             else:
                 def do_f(ring):
-                    y = fwd_fn(locals_, inp)
+                    y = fwd_fn(chunk_f, inp)
                     ring = jax.lax.dynamic_update_index_in_dim(
-                        ring, inp, mfc % ring_n, 0)
+                        ring, inp, slot_f, 0)
                     return y, ring
 
             y, ring = _branch(
                 active_f, do_f, lambda ring: (inp, ring), ring)
 
-            # ---- backward: B_s(m) at t = m + 2S-1-s --------------------
-            mb = t - (2 * s_count - 1) + stage
-            active_b = (mb >= 0) & (mb < n_micro)
-            mbc = jnp.clip(mb, 0, n_micro - 1)
-            slot_b = mbc % ring_n
+            # ---- backward ----------------------------------------------
+            if v == 1:
+                # B_s(m) at t = m + 2S-1-s
+                mb = t - (2 * s_count - 1) + stage
+                active_b = (mb >= 0) & (mb < n_micro)
+                mbc = jnp.clip(mb, 0, n_micro - 1)
+                slot_b = mbc % ring_n
+                chunk_b = locals_
+                lap_b = jnp.zeros((), t.dtype)
+                is_last_chunk = is_last
+            else:
+                # mirror schedule with delay D = vS: B of chunk lap·S+d
+                # at t = D + r·vS + (v-1-lap)·S + j + (S-1-d)
+                ub = t - v * s_count - (s_count - 1 - stage)
+                ubc = jnp.clip(ub, 0, span - 1)
+                r_b = ubc // (v * s_count)
+                q_b = ubc % (v * s_count)
+                lap_b = v - 1 - q_b // s_count
+                j_b = q_b % s_count
+                mb = r_b * s_count + j_b
+                active_b = (ub >= 0) & (ub < span) & (mb < n_micro)
+                mbc = jnp.clip(mb, 0, n_micro - 1)
+                # ring slot keyed on the F tick of the same (chunk, m)
+                slot_b = (r_b * v * s_count + lap_b * s_count
+                          + j_b) % ring_n
+                chunk_b = [jax.lax.dynamic_index_in_dim(p, lap_b, 0,
+                                                        False)
+                           for p in locals_]
+                is_last_chunk = is_last & (lap_b == v - 1)
             sinp = None if stash else ring[slot_b]
 
             def _apply_saved_vjp(ct):
@@ -453,7 +545,7 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                 else:
                     (s_, c_), vjp = jax.vjp(
                         lambda ch, ip, tp: last_fn(ch, ip, tp, lbls),
-                        locals_, sinp, tuple(tail_params))
+                        chunk_b, sinp, tuple(tail_params))
                     dch, dip, dtp = vjp((seed(s_, 1.0), seed(c_, 0.0)))
                 # cotangents of replicated (unvaried) inputs come back
                 # unvaried — align vma/pytree with the other branches
@@ -469,7 +561,7 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                     dch, dip = _apply_saved_vjp(bcarry)
                 else:
                     _, vjp = jax.vjp(
-                        lambda ch, ip: fwd_fn(ch, ip), locals_, sinp)
+                        lambda ch, ip: fwd_fn(ch, ip), chunk_b, sinp)
                     dch, dip = vjp(bcarry)
                 dch = tuple(_pvary(g, pp_axis) for g in dch)
                 zt = tuple(_pvary(jnp.zeros(t.shape, t.dtype), pp_axis)
@@ -478,11 +570,11 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                 return dch, _pvary(dip, pp_axis), zt, z, z
 
             def do_b(_):
-                return _branch(is_last, bwd_last, bwd_mid, None)
+                return _branch(is_last_chunk, bwd_last, bwd_mid, None)
 
             def skip_b(_):
-                zc = tuple(_pvary(jnp.zeros(c.shape, c.dtype), pp_axis)
-                           for c in locals_)
+                zc = tuple(_pvary(jnp.zeros(sh, p.dtype), pp_axis)
+                           for sh, p in zip(chunk_shapes, locals_))
                 zt = tuple(_pvary(jnp.zeros(t.shape, t.dtype), pp_axis)
                            for t in tail_params)
                 z = _pvary(jnp.zeros((), jnp.float32), pp_axis)
@@ -490,15 +582,24 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
 
             dch, dip, dtp, ds, dc = _branch(active_b, do_b, skip_b,
                                             None)
-            gp = tuple(g + d.astype(jnp.float32)
-                       for g, d in zip(gp, dch))
+            if v == 1:
+                gp = tuple(g + d.astype(jnp.float32)
+                           for g, d in zip(gp, dch))
+            else:
+                # scatter-add the chunk grad into its lap slot
+                gp = tuple(
+                    jax.lax.dynamic_update_index_in_dim(
+                        g, jax.lax.dynamic_index_in_dim(g, lap_b, 0,
+                                                        False)
+                        + d.astype(jnp.float32), lap_b, 0)
+                    for g, d in zip(gp, dch))
             gt = tuple(g + d.astype(jnp.float32)
                        for g, d in zip(gt, dtp))
             lsum = lsum + ds
             cnt = cnt + dc
-            # stage 0's dinp is the cotangent of this microbatch's input
+            # stage 0's (lap 0's) dinp is this microbatch's input grad
             dxm = jnp.where(
-                active_b & (stage == 0),
+                active_b & (stage == 0) & (lap_b == 0),
                 jax.lax.dynamic_update_index_in_dim(
                     dxm, dip.astype(jnp.float32), mbc, 0),
                 dxm)
@@ -531,24 +632,26 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
     return jax.jit(mapped)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 9, 10))
 def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
                         x_micro, extra, tail_params, tail_indexed,
-                        stash: bool = False):
+                        stash: bool = False, n_virtual: int = 1):
     """Mean loss of the pipelined model+loss-head under the 1F1B
-    schedule.  ``tail_fn`` must return ``(loss_sum, valid_count)``; the
-    result is Σloss_sum / max(Σcount, 1) over all microbatches.
+    schedule (interleaved when ``n_virtual > 1``).  ``tail_fn`` must
+    return ``(loss_sum, valid_count)``; the result is
+    Σloss_sum / max(Σcount, 1) over all microbatches.
 
     Differentiable via custom_vjp: under jax.grad the fwd rule runs the
     fused 1F1B loop ONCE, producing loss and all gradients together
     (ring buffers ⇒ activation memory ∝ pp, not n_micro); without grad,
     the plain forward pipeline runs (cond-guarded tail).
-    stacked: tuple of [S, per_chunk, ...] arrays (global chunk order,
-    n_virtual==1).  ``stash``: ring-buffer VJP residuals so backward
-    ticks skip the forward recompute (see _jitted_1f1b)."""
+    stacked: tuple of [n_virtual*S, per_chunk, ...] arrays in global
+    chunk order.  ``stash``: ring-buffer VJP residuals so backward
+    ticks skip the forward recompute (n_virtual==1 only — see
+    _jitted_1f1b)."""
     loss_sum, count = gpipe_spmd(
         list(stacked), x_micro, stage_fn, *extra, mesh=mesh,
-        pp_axis=pp_axis, n_virtual=1, tail_fn=tail_fn,
+        pp_axis=pp_axis, n_virtual=n_virtual, tail_fn=tail_fn,
         tail_params=tuple(tail_params),
         tail_indexed=tuple(tail_indexed), tail_cond=True)
     return loss_sum / jnp.maximum(count, 1.0)
@@ -556,12 +659,26 @@ def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
 
 def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
                      extra, tail_params, tail_indexed,
-                     stash: bool = False):
+                     stash: bool = False, n_virtual: int = 1):
     eng = _jitted_1f1b(stage_fn, tail_fn, mesh, pp_axis, len(stacked),
                        len(extra), len(tail_params), len(tail_indexed),
-                       stash)
-    lsum, cnt, gp, dxm, gt = eng(tuple(stacked), x_micro, *extra,
+                       stash, n_virtual)
+    v = n_virtual
+    nstage = mesh.shape[pp_axis]
+    if v > 1:
+        # interleaved placement: [v*S, per, ...] -> [S, v, per, ...]
+        eng_stacked = tuple(
+            jnp.swapaxes(p.reshape((v, nstage) + p.shape[1:]), 0, 1)
+            for p in stacked)
+    else:
+        eng_stacked = tuple(stacked)
+    lsum, cnt, gp, dxm, gt = eng(eng_stacked, x_micro, *extra,
                                  *tail_params, *tail_indexed)
+    if v > 1:
+        # [S, v, per, ...] grads back to global chunk order
+        gp = tuple(
+            jnp.swapaxes(g, 0, 1).reshape((v * nstage,) + g.shape[2:])
+            for g in gp)
     denom = jnp.maximum(cnt, 1.0)
     loss = lsum / denom
     # cotangents must come back in the primal dtypes; scale-by-ct in
@@ -572,7 +689,8 @@ def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
     return loss, (gp, dxm, gt, denom)
 
 
-def _ptrain_1f1b_bwd(stage_fn, tail_fn, mesh, pp_axis, stash, res, ct):
+def _ptrain_1f1b_bwd(stage_fn, tail_fn, mesh, pp_axis, stash, n_virtual,
+                     res, ct):
     gp, dxm, gt, denom = res
     scale = ct / denom
     dstacked = tuple((g * scale).astype(g.dtype) for g in gp)

@@ -562,19 +562,16 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     per_chunk = n_layers // n_chunks
     stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
                for p in params]
-    if n_virtual == 1:
-        # training default: fused 1F1B schedule (activation memory ∝ pp
-        # in-flight microbatches, not n_micro); custom_vjp, so this is
-        # also the eval path (plain fwd pipeline) when not under grad
-        from ..distributed.pipeline import pipeline_train_1f1b
-        return pipeline_train_1f1b(
-            stage_fn, tail_fn, pm.mesh, pp_axis, tuple(stacked), xm,
-            (cos, sin), (norm_w, head_w), (lm,), stash_residuals)
-    loss_sum, count = gpipe_spmd(
-        stacked, xm, stage_fn, cos, sin, mesh=pm.mesh, pp_axis=pp_axis,
-        n_virtual=n_virtual, tail_fn=tail_fn,
-        tail_params=(norm_w, head_w), tail_indexed=(lm,))
-    return loss_sum / jnp.maximum(count, 1.0)
+    # training default: fused 1F1B schedule — interleaved when
+    # n_virtual > 1 (activation memory ∝ pp in-flight microbatches,
+    # not n_micro); custom_vjp, so this is also the eval path (plain
+    # fwd pipeline) when not under grad.  Residual stashing requires
+    # v == 1 (weight-identity filtering needs static chunk tracers).
+    from ..distributed.pipeline import pipeline_train_1f1b
+    return pipeline_train_1f1b(
+        stage_fn, tail_fn, pm.mesh, pp_axis, tuple(stacked), xm,
+        (cos, sin), (norm_w, head_w), (lm,),
+        stash_residuals and n_virtual == 1, n_virtual)
 
 
 def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
