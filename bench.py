@@ -429,6 +429,89 @@ def bench_decode():
                       "per_seq_tokens_per_sec": round(new / dt, 1)}}
 
 
+def bench_paged_kernel():
+    """On-chip serving KERNEL row (VERDICT r3 Missing #6): per-decode-
+    step device time of the fused paged append+attend kernel vs the
+    dense-cache decode attention, both lax.scan-serialized IN-GRAPH so
+    the axon tunnel's dispatch latency cannot contaminate the numbers
+    (the engine row below is tunnel-bound).  llama-770m attention
+    geometry at batch 8 x 2048 context."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import _nn
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_decode_append_attend)
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if not on_tpu:
+        return {"metric": "paged_decode_kernel_us_per_step",
+                "unit": "us", "value": -1.0,
+                "extra": {"note": "tpu_only_row"}}
+    B, H, KVH, D, PAGE, CTX = 8, 12, 4, 128, 128, 2048
+    MAXP, N = CTX // PAGE, 256
+    rng = np.random.default_rng(0)
+    kp0 = jnp.asarray(rng.standard_normal((KVH, B * MAXP, PAGE, D)) * .1,
+                      jnp.bfloat16)
+    vp0 = jnp.asarray(rng.standard_normal((KVH, B * MAXP, PAGE, D)) * .1,
+                      jnp.bfloat16)
+    table = jnp.asarray(rng.permutation(B * MAXP).reshape(B, MAXP),
+                        jnp.int32)
+    lens0 = jnp.full((B,), CTX - N - 1, jnp.int32)
+    k_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.bfloat16)
+    q3 = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+    kd0 = jnp.asarray(rng.standard_normal((B, CTX, KVH, D)) * .1,
+                      jnp.bfloat16)
+    q4 = q3[:, None]
+
+    def timed(f, *args):
+        f = jax.jit(f)
+        jax.block_until_ready(f(*args))
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best / N * 1e6
+
+    def paged(kp, vp, lens):
+        def body(c, _):
+            kp, vp, lens, q_ = c
+            o, kp, vp = paged_decode_append_attend(
+                q_, kp, vp, k_new, k_new, table, lens)
+            return (kp, vp, lens + 1, q3 + o * 1e-6), None
+        c, _ = jax.lax.scan(body, (kp, vp, lens, q3), None, length=N)
+        return c[3]
+
+    def dense(kd, vd, lens):
+        def body(c, _):
+            kd, vd, lens, q_ = c
+            kd = jax.lax.dynamic_update_slice(
+                kd, (k_new + kd[0, 0, 0, 0] * 0)[:, None],
+                (0, lens[0], 0, 0))
+            vd = jax.lax.dynamic_update_slice(vd, k_new[:, None],
+                                              (0, lens[0], 0, 0))
+            lens = lens + 1
+            am = jnp.where(jnp.arange(CTX)[None, :] < lens[:, None],
+                           0.0, -1e30)[:, None, None, :]
+            o = _nn.scaled_dot_product_attention(q_, kd, vd,
+                                                 attn_mask=am)
+            return (kd, vd, lens, q4 + o * 1e-6), None
+        c, _ = jax.lax.scan(body, (kd, vd, lens, q4), None, length=N)
+        return c[3]
+
+    t_paged = timed(paged, kp0, vp0, lens0)
+    t_dense = timed(dense, kd0, vp0.reshape(B, CTX, KVH, D), lens0)
+    return {"metric": "paged_decode_kernel_us_per_step",
+            "unit": "us", "value": round(t_paged, 1),
+            "extra": {"device_kind": kind, "batch": B, "context": CTX,
+                      "page_size": PAGE,
+                      "dense_us_per_step": round(t_dense, 1),
+                      "paged_over_dense": round(t_paged / t_dense, 2),
+                      "note": "fused append+attend kernel, in-graph "
+                              "scan x256; r3 path was ~18x dense"}}
+
+
 def bench_engine():
     """Serving-engine row: continuous-batching decode tokens/sec through
     the paged-KV LLMEngine (bucketed prefill admission + ragged paged
@@ -540,6 +623,7 @@ def main():
                ("bench_gpt2", bench_gpt2), ("bench_ernie", bench_ernie),
                ("bench_dit", bench_dit), ("bench_moe", bench_moe),
                ("bench_decode", bench_decode),
+               ("bench_paged_kernel", bench_paged_kernel),
                ("bench_engine", bench_engine),
                ("bench_longseq", bench_longseq)]
         failed = 0

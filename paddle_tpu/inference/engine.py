@@ -162,9 +162,8 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     import jax.numpy as jnp
 
     from ..ops import _nn
-    from ..ops.pallas.paged_attention import (paged_attention_raw,
-                                              paged_attention_reference,
-                                              paged_write)
+    from ..ops.pallas.paged_attention import (
+        paged_decode_append_attend, paged_decode_append_attend_reference)
     from ..runtime.device import is_compiled_with_tpu
 
     cos_t, sin_t = rope                       # [maxpos, D]
@@ -173,8 +172,11 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     from ..models.llama import _rotate_half as rotate_half
     from ..nn.generation import sample_logits
 
-    attend = paged_attention_raw if is_compiled_with_tpu() \
-        else paged_attention_reference
+    # ONE fused kernel appends this step's K/V and attends over them —
+    # the separate XLA paged_write rewrote the whole pool per step on
+    # TPU (round-3 serving bottleneck; see paged_attention.py)
+    append_attend = paged_decode_append_attend if is_compiled_with_tpu() \
+        else paged_decode_append_attend_reference
 
     def one_token(carry):
         tokens, positions, lens, k_pages, v_pages, key = carry
@@ -195,8 +197,7 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
             kf = k.astype(jnp.float32)
             q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
             k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
-            kp, vp = paged_write(kp, vp, k, v, tables, lens)
-            attn = attend(q, kp, vp, tables, lens + 1)  # incl. new tok
+            attn, kp, vp = append_attend(q, kp, vp, k, v, tables, lens)
             hcur = hcur + jnp.matmul(attn.reshape(b, nh * head_dim), ow)
             hn = _nn.rms_norm(hcur, pln, epsilon=eps)
             ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)

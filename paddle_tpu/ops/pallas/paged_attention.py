@@ -8,13 +8,20 @@ Flexible LLM Inference Kernel for TPU" (PAPERS.md).
 TPU-native design: the KV cache lives in fixed-size PAGES
 ([KVH, n_pages, page_size, D]) so ragged per-sequence lengths share one
 physical pool with no padding waste; a per-sequence page table maps
-logical page slots to physical pages.  The decode kernel runs one grid
-step per (sequence, kv-head, page): the page table is a SCALAR-PREFETCH
-operand, so each page's HBM→VMEM DMA address is computed from it before
-the body runs (Pallas double-buffers the streams); online softmax
-accumulates across a sequence's pages in VMEM scratch, pages past the
-sequence's length are skipped (`@pl.when`), and the query-head group of
-each KV head (GQA) rides the same page DMA.
+logical page slots to physical pages.
+
+The decode kernel runs one grid step per (sequence, kv-head) — NOT per
+page: the page pool stays in HBM (``memory_space=ANY``) and the body
+streams that sequence's pages itself with MANUALLY-issued async copies
+(``pltpu.make_async_copy``) into a double-buffered VMEM scratch, so
+page i+1's DMA overlaps page i's online-softmax accumulation and the
+grid-step count is B·KVH instead of B·KVH·max_pages.  The round-3
+per-page-grid variant spent ~3.5 µs of Mosaic grid/DMA-setup overhead
+per TINY page step (1024 steps ≈ 3.6 ms at batch 8 × 2k context);
+this design is what the ragged-paged-attention paper's kernel does and
+measures ~30× faster (see BASELINE.md serving rows).  The query-head
+group of each KV head (GQA) rides the same page DMA; pages past a
+sequence's length are never copied.
 """
 from __future__ import annotations
 
@@ -26,51 +33,113 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["paged_attention_raw", "paged_attention_reference",
-           "paged_write"]
+           "paged_write", "paged_decode_append_attend",
+           "paged_decode_append_attend_reference"]
 
 _NEG_INF = float(-1e30)
 _LANES = 128
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, page_size, maxp):
-    b, i = pl.program_id(0), pl.program_id(2)
+_NBUF = 4          # DMA pipeline depth: outstanding page copies per stream
 
-    @pl.when(i == 0)
-    def _():
-        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    length = len_ref[b]
-    npages = (length + page_size - 1) // page_size
+def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,
+                  length, npages, page_size, inject=None):
+    """Online-softmax attention over a sequence's pages, streamed from
+    HBM with an _NBUF-deep manual DMA pipeline.  ``inject``: optional
+    (append_page, append_slot, k_row [D], v_row [D]) — substituted into
+    the streamed page in registers, and the modified page handed to the
+    caller through the returned ``wpage`` (k_mod, v_mod) pair for
+    write-back.  Returns (l, acc, kmod, vmod)."""
 
-    @pl.when(i < npages)
-    def _():
-        q = q_ref[0, 0].astype(jnp.float32) * scale        # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)                # [P, D]
+    def k_copy(i, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[h, pt_ref[b, i]], k_scr.at[slot], sem.at[slot, 0])
+
+    def v_copy(i, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[h, pt_ref[b, i]], v_scr.at[slot], sem.at[slot, 1])
+
+    for j in range(_NBUF):
+        @pl.when(j < npages)
+        def _(j=j):
+            k_copy(j, j).start()
+            v_copy(j, j).start()
+
+    g = q.shape[0]
+    d = q.shape[1]
+    m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
+
+    def body(i, carry):
+        if inject is not None:
+            m, l, acc, kmod, vmod = carry
+        else:
+            m, l, acc = carry
+        slot = jax.lax.rem(i, _NBUF)
+
+        k_copy(i, slot).wait()
+        v_copy(i, slot).wait()
+        k = k_scr[slot].astype(jnp.float32)                # [P, D]
+        v = v_scr[slot].astype(jnp.float32)
+        if inject is not None:
+            ap, aslot, krow, vrow = inject
+            hit = i == ap
+            rowsel = jax.lax.broadcasted_iota(
+                jnp.int32, (page_size, 1), 0) == aslot
+            sel = jnp.logical_and(hit, rowsel)
+            k = jnp.where(sel, krow[None, :], k)
+            v = jnp.where(sel, vrow[None, :], v)
+            kmod = jnp.where(hit, k, kmod)
+            vmod = jnp.where(hit, v, vmod)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         pos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(pos < length, s, _NEG_INF)
-
-        m_prev = m_scr[:, 0][:, None]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)                             # [G, P]
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_scr[:, 0][:, None] * alpha + jnp.sum(p, axis=1)[:, None]
-        v = v_ref[0, 0].astype(jnp.float32)                # [P, D]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_scr[...] = acc_scr[...] * alpha + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    @pl.when(i == maxp - 1)
+        # refill this slot only after the dots consumed its data
+        @pl.when(i + _NBUF < npages)
+        def _():
+            k_copy(i + _NBUF, slot).start()
+            v_copy(i + _NBUF, slot).start()
+        if inject is not None:
+            return m_new, l_new, acc * alpha + pv, kmod, vmod
+        return m_new, l_new, acc * alpha + pv
+
+    if inject is not None:
+        kz = jnp.zeros((page_size, d), jnp.float32)
+        _, l, acc, kmod, vmod = jax.lax.fori_loop(
+            0, npages, body, (m0, l0, acc0, kz, kz))
+        return l, acc, kmod, vmod
+    _, l, acc = jax.lax.fori_loop(0, npages, body, (m0, l0, acc0))
+    return l, acc, None, None
+
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
+                   k_scr, v_scr, sem, *, scale, page_size, maxp):
+    b, h = pl.program_id(0), pl.program_id(1)
+    length = len_ref[b]
+    npages = jnp.minimum((length + page_size - 1) // page_size, maxp)
+
+    @pl.when(npages == 0)
     def _():
-        l = jnp.maximum(l_scr[:, 0][:, None], 1e-30)
-        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        o_ref[0, 0] = jnp.zeros(o_ref.shape[2:], o_ref.dtype)
+
+    @pl.when(npages > 0)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale        # [G, D]
+        l, acc, _, _ = _stream_pages(
+            pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem, length,
+            npages, page_size)
+        o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale",))
@@ -96,7 +165,7 @@ def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
         scale = 1.0 / (d ** 0.5)
     qg = q.reshape(b, kvh, g, d)
 
-    grid = (b, kvh, maxp)
+    grid = (b, kvh)
     kernel = functools.partial(_decode_kernel, scale=scale,
                                page_size=page_size, maxp=maxp)
     out = pl.pallas_call(
@@ -106,27 +175,141 @@ def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, g, d),
-                             lambda b_, h_, i, pt, ln: (b_, h_, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda b_, h_, i, pt, ln: (h_, pt[b_, i],
-                                                        0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda b_, h_, i, pt, ln: (h_, pt[b_, i],
-                                                        0, 0)),
+                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+                # page pools stay in HBM; the kernel streams pages with
+                # manual double-buffered async copies
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
             ],
             out_specs=pl.BlockSpec((1, 1, g, d),
-                                   lambda b_, h_, i, pt, ln: (b_, h_,
-                                                              0, 0)),
+                                   lambda b_, h_, pt, ln: (b_, h_,
+                                                           0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((g, _LANES), jnp.float32),
-                pltpu.VMEM((g, _LANES), jnp.float32),
-                pltpu.VMEM((g, d), jnp.float32),
+                pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
+                pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((_NBUF, 2)),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
       qg, k_pages, v_pages)
     return out.reshape(b, h, d)
+
+
+def _decode_append_kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
+                          k_in, v_in, o_ref, k_out, v_out,
+                          k_scr, v_scr, w_scr, sem, wsem,
+                          *, scale, page_size, maxp):
+    b, h = pl.program_id(0), pl.program_id(1)
+    pos = len_ref[b]                        # append position
+    length = pos + 1                        # attend incl. the new token
+    npages = jnp.minimum((length + page_size - 1) // page_size, maxp)
+    ap = pos // page_size
+    aslot = pos % page_size
+
+    # this kv-head's new K/V rows: select row h from the [KVH, D] block
+    kvh = knew_ref.shape[1]
+    hsel = jax.lax.broadcasted_iota(jnp.int32, (kvh, 1), 0) == h
+    krow = jnp.sum(jnp.where(hsel, knew_ref[0].astype(jnp.float32), 0.0),
+                   axis=0)                                  # [D]
+    vrow = jnp.sum(jnp.where(hsel, vnew_ref[0].astype(jnp.float32), 0.0),
+                   axis=0)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale             # [G, D]
+    l, acc, kmod, vmod = _stream_pages(
+        pt_ref, b, h, q, k_in, v_in, k_scr, v_scr, sem, length, npages,
+        page_size, inject=(ap, aslot, krow, vrow))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+    # write the modified append page back with ONE full-page DMA (the
+    # row-granular write is a register select above — no sublane-
+    # alignment constraints, unlike a direct scatter/partial DMA)
+    w_scr[0] = kmod.astype(w_scr.dtype)
+    w_scr[1] = vmod.astype(w_scr.dtype)
+    kw = pltpu.make_async_copy(w_scr.at[0], k_out.at[h, pt_ref[b, ap]],
+                               wsem.at[0])
+    vw = pltpu.make_async_copy(w_scr.at[1], v_out.at[h, pt_ref[b, ap]],
+                               wsem.at[1])
+    kw.start()
+    vw.start()
+    kw.wait()
+    vw.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("scale",),
+                   donate_argnums=(1, 2))
+def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
+                               page_table, seq_lens, *, scale=None):
+    """Fused decode step: append ``k_new``/``v_new`` [B, KVH, D] at
+    position ``seq_lens[b]`` AND attend ``q`` [B, H, D] over the
+    ``seq_lens[b] + 1`` tokens, in ONE kernel.
+
+    The page pools alias input→output (donated), so the only KV-cache
+    writes are one modified page per (sequence, kv-head) — the XLA
+    ``paged_write`` scatter/dus path rewrites the whole pool per step
+    on TPU (dynamic sublane offsets defeat in-place updates) and was
+    the round-3 serving bottleneck.  Returns (out [B, H, D], k_pages',
+    v_pages'); caller bumps seq_lens.
+    """
+    b, h, d = q.shape
+    kvh, n_pages, page_size, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    g = h // kvh
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qg = q.reshape(b, kvh, g, d)
+
+    kernel = functools.partial(_decode_append_kernel, scale=scale,
+                               page_size=page_size, maxp=maxp)
+    out, kp, vp = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, kvh),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, kvh, d),
+                             lambda b_, h_, pt, ln: (b_, 0, 0)),
+                pl.BlockSpec((1, kvh, d),
+                             lambda b_, h_, pt, ln: (b_, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
+                pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
+                pltpu.VMEM((2, page_size, d), k_pages.dtype),
+                pltpu.SemaphoreType.DMA((_NBUF, 2)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        input_output_aliases={5: 1, 6: 2},
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype),
+      k_pages, v_pages)
+    return out.reshape(b, h, d), kp, vp
+
+
+def paged_decode_append_attend_reference(q, k_pages, v_pages, k_new,
+                                         v_new, page_table, seq_lens):
+    """jnp oracle / CPU path for the fused decode step."""
+    k_pages, v_pages = paged_write(k_pages, v_pages, k_new, v_new,
+                                   page_table, seq_lens)
+    out = paged_attention_reference(q, k_pages, v_pages, page_table,
+                                    seq_lens + 1)
+    return out, k_pages, v_pages
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens):
@@ -158,14 +341,23 @@ def paged_write(k_pages, v_pages, k_new, v_new, page_table, seq_lens):
     k_new/v_new: [B, KVH, D]; the token lands at logical position
     seq_lens[b] (page page_table[b, pos // P], slot pos % P).
     Returns (k_pages, v_pages) updated; caller bumps seq_lens.
+
+    Implemented as B chained ``dynamic_update_slice``s (statically
+    unrolled) rather than one gather-indexed scatter: XLA:TPU keeps a
+    dus chain fully in place, while the scatter lowering was the
+    round-3 serving bottleneck (sorting/serializing per element).
     """
     page_size = k_pages.shape[2]
-    bidx = jnp.arange(k_new.shape[0])
-    pos = seq_lens
-    page = page_table[bidx, pos // page_size]               # [B]
-    slot = pos % page_size
-    k_pages = k_pages.at[:, page, slot, :].set(
-        jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype))
-    v_pages = v_pages.at[:, page, slot, :].set(
-        jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype))
+    b = k_new.shape[0]
+    kt = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)    # [KVH, B, D]
+    vt = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(b):
+        page = page_table[i, seq_lens[i] // page_size]
+        slot = seq_lens[i] % page_size
+        idx = (zero, page, slot, zero)
+        k_pages = jax.lax.dynamic_update_slice(
+            k_pages, kt[:, i][:, None, None, :], idx)
+        v_pages = jax.lax.dynamic_update_slice(
+            v_pages, vt[:, i][:, None, None, :], idx)
     return k_pages, v_pages

@@ -86,6 +86,42 @@ class TestPagedAttentionKernel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_fused_append_attend_matches_reference(self):
+        """One kernel appends K/V and attends incl. the new token; the
+        returned pools equal the scatter-written ones exactly."""
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_append_attend,
+            paged_decode_append_attend_reference)
+        rng = np.random.default_rng(7)
+        kvh, g, d, page, maxp = 2, 2, 16, 8, 4
+        b = 4
+        h = kvh * g
+        k_pages, v_pages = _rand_pages(rng, kvh, 32, page, d)
+        q = jnp.asarray(rng.normal(size=(b, h, d)).astype(np.float32))
+        kn = jnp.asarray(rng.normal(size=(b, kvh, d)).astype(np.float32))
+        vn = jnp.asarray(rng.normal(size=(b, kvh, d)).astype(np.float32))
+        table = np.zeros((b, maxp), np.int32)
+        nxt = 1
+        for i in range(b):
+            for j in range(maxp):
+                table[i, j] = nxt
+                nxt += 1
+        table = jnp.asarray(table)
+        # page-edge cases: empty, mid-page, page boundary, full-1
+        lens = jnp.asarray([0, 5, 8, 23], jnp.int32)
+        want_o, want_k, want_v = paged_decode_append_attend_reference(
+            q, k_pages, v_pages, kn, vn, table, lens)
+        with pltpu.force_tpu_interpret_mode():
+            got_o, got_k, got_v = paged_decode_append_attend(
+                q, k_pages, v_pages, kn, vn, table, lens)
+        np.testing.assert_allclose(np.asarray(got_o),
+                                   np.asarray(want_o), rtol=2e-5,
+                                   atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(got_k),
+                                      np.asarray(want_k))
+        np.testing.assert_array_equal(np.asarray(got_v),
+                                      np.asarray(want_v))
+
     def test_paged_write_places_token(self):
         rng = np.random.default_rng(1)
         k_pages, v_pages = _rand_pages(rng)
