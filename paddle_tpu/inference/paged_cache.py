@@ -133,24 +133,21 @@ class PagedKVCache:
         k_new/v_new: [B, KVH, D] (num_layers==1) or [L, B, KVH, D];
         lengths advance by 1 (once, across all layers)."""
         import jax.numpy as jnp
-
-        from ..ops.pallas.paged_attention import paged_write
         k_new, v_new = self._norm_layers(k_new, v_new, 1)
         slots = np.atleast_1d(slots)
         for s in slots:
             self.extend(int(s), 1)
-        table = jnp.asarray(self._table[slots])
-        lens = jnp.asarray(self._lens[slots])
-        # per-layer dus-chain writes (paged_write) — the old gather-
-        # indexed scatter rewrote the whole pool per token on TPU
-        kps, vps = [], []
-        for li in range(self.k_pages.shape[0]):
-            kp, vp = paged_write(self.k_pages[li], self.v_pages[li],
-                                 k_new[li], v_new[li], table, lens)
-            kps.append(kp)
-            vps.append(vp)
-        self.k_pages = jnp.stack(kps)
-        self.v_pages = jnp.stack(vps)
+        pos = self._lens[slots]
+        pages = jnp.asarray(self._table[slots, pos // self.page_size])
+        slot_in_page = jnp.asarray(pos % self.page_size)
+        # ONE all-layer scatter: this method is EAGER (each op call
+        # copies its output), so a per-layer dus chain would copy the
+        # pool 2·L·B times per token; the jit-compiled serving path
+        # (engine's fused append+attend kernel) never comes through here
+        kt = jnp.swapaxes(k_new, 1, 2).astype(self.k_pages.dtype)
+        vt = jnp.swapaxes(v_new, 1, 2).astype(self.v_pages.dtype)
+        self.k_pages = self.k_pages.at[:, :, pages, slot_in_page, :].set(kt)
+        self.v_pages = self.v_pages.at[:, :, pages, slot_in_page, :].set(vt)
         self.advance(slots, 1)
 
     def attend(self, slots, q, layer: int = 0,

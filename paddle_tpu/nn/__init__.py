@@ -6,6 +6,7 @@ from .container import LayerDict, LayerList, ParameterList, Sequential
 from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .transformer import (
     MultiHeadAttention,
     Transformer,

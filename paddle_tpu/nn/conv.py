@@ -14,7 +14,7 @@ from .layer import Layer
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "MaxPool2D",
            "AvgPool2D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
-           "MaxPool1D", "AvgPool1D"]
+           "MaxPool1D", "AvgPool1D", "MaxPool3D", "AvgPool3D"]
 
 
 class _ConvNd(Layer):
@@ -163,6 +163,34 @@ class AvgPool1D(Layer):
         out = F.avg_pool2d(x4, (1, self.kernel_size), (1, self.stride),
                            (0, self.padding), exclusive=self.exclusive)
         return ops.squeeze(out, 2)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride,
+                            self.padding, self.ceil_mode)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride,
+                            self.padding, exclusive=self.exclusive)
 
 
 class AdaptiveAvgPool2D(Layer):

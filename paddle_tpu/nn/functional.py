@@ -18,13 +18,22 @@ from ..ops.api import (  # noqa: F401
     gelu, glu, group_norm,
     gumbel_softmax, hardshrink, hardsigmoid, hardswish, hardtanh,
     instance_norm, interpolate, kl_div, l1_loss, label_smooth, layer_norm,
-    leaky_relu, linear, log_softmax, logsigmoid, max_pool2d, maxout, mish,
+    leaky_relu, linear, log_softmax, logsigmoid, max_pool2d, max_pool3d,
+    avg_pool3d, maxout, mish,
     mse_loss, nll_loss, normalize, one_hot, pad, pixel_shuffle, prelu,
     relu, relu6, rms_norm, selu, sigmoid, sigmoid_focal_loss, silu,
     smooth_l1_loss, softmax, softplus, softshrink, softsign, swish,
     tanhshrink, thresholded_relu, unfold,
     affine_grid, alpha_dropout, channel_shuffle, dropout2d, dropout3d,
     fold, fused_linear, grid_sample, pixel_unshuffle, upsample,
+    square_error_cost, log_loss, hinge_embedding_loss,
+    cosine_embedding_loss, margin_ranking_loss, pairwise_distance,
+    triplet_margin_loss, triplet_margin_with_distance_loss,
+    soft_margin_loss, multi_label_soft_margin_loss, poisson_nll_loss,
+    gaussian_nll_loss, ctc_loss, zeropad2d, local_response_norm,
+    temporal_shift, rrelu, max_pool1d, avg_pool1d, adaptive_avg_pool1d,
+    adaptive_max_pool1d, adaptive_avg_pool3d, adaptive_max_pool3d,
+    lp_pool1d, lp_pool2d, max_unpool2d, embedding_bag,
 )
 from ..ops import api as _api
 from ..tensor import apply_op

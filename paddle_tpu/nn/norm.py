@@ -18,7 +18,7 @@ from ..tensor import Tensor
 
 __all__ = ["LayerNorm", "RMSNorm", "GroupNorm", "BatchNorm", "BatchNorm1D",
            "BatchNorm2D", "BatchNorm3D", "InstanceNorm1D", "InstanceNorm2D",
-           "SyncBatchNorm", "LocalResponseNorm"]
+           "SyncBatchNorm", "LocalResponseNorm", "SpectralNorm"]
 
 
 class LayerNorm(Layer):
@@ -216,3 +216,60 @@ class LocalResponseNorm(Layer):
             acc = sum(padded[:, i:i + x.shape[1]] for i in range(self.size))
             return x / jnp.power(self.k + self.alpha * acc, self.beta)
         return apply_op(_lrn, x)
+
+
+class SpectralNorm(Layer):
+    """paddle.nn.SpectralNorm parity: forward(weight) returns
+    weight / sigma_max estimated by ``power_iters`` rounds of power
+    iteration around axis ``dim``; the u/v estimates persist as
+    buffers and warm-start the next call (updated only in training,
+    paddle's semantics)."""
+
+    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1,
+                 eps: float = 1e-12, dtype="float32"):
+        super().__init__()
+        import numpy as np
+
+        from ..tensor import Tensor
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._shape = tuple(int(s) for s in weight_shape)
+        h = self._shape[dim]
+        w = int(np.prod(self._shape)) // h
+        rng = np.random.default_rng(0)
+
+        def unit(n):
+            v = rng.standard_normal(n).astype(np.float32)
+            return v / (np.linalg.norm(v) + eps)
+        self.register_buffer("weight_u", Tensor(unit(h)))
+        self.register_buffer("weight_v", Tensor(unit(w)))
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ..tensor import apply_op
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+        training = self.training
+
+        def _sn(w, u, v):
+            import jax
+            perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            u_, v_ = u, v
+            for _ in range(max(iters, 1)):
+                v_ = mat.T @ u_
+                v_ = v_ / (jnp.linalg.norm(v_) + eps)
+                u_ = mat @ v_
+                u_ = u_ / (jnp.linalg.norm(u_) + eps)
+            u_ = jax.lax.stop_gradient(u_)
+            v_ = jax.lax.stop_gradient(v_)
+            sigma = jnp.dot(u_, mat @ v_)
+            return w / sigma, u_, v_
+
+        out, u_new, v_new = apply_op(_sn, weight, self.weight_u,
+                                     self.weight_v)
+        if training:
+            self.weight_u.set_value(u_new.numpy())
+            self.weight_v.set_value(v_new.numpy())
+        return out

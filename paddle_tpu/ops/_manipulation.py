@@ -478,3 +478,47 @@ def crop(x, shape=None, offsets=None):
     offsets = [0] * x.ndim if offsets is None else list(offsets)
     idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
+
+
+# -- round-4 long-tail batch (VERDICT r3 Missing #3) ------------------------
+
+def tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return tuple(jnp.array_split(x, num_or_indices, axis=axis))
+    return tuple(jnp.split(x, list(num_or_indices), axis=axis))
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def column_stack(x):
+    return jnp.column_stack(tuple(x))
+
+
+def row_stack(x):
+    return jnp.vstack(tuple(x))
+
+
+def dstack(x):
+    return jnp.dstack(tuple(x))
+
+
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+def flipud(x):
+    return jnp.flipud(x)
+
+
+def broadcast_tensors(inputs):
+    return tuple(jnp.broadcast_arrays(*inputs))

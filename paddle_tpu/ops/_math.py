@@ -581,3 +581,107 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
 
 def dist(x, y, p=2.0):
     return jnp.linalg.norm((x - y).ravel(), ord=p)
+
+
+# -- round-4 long-tail batch (VERDICT r3 Missing #3) ------------------------
+
+def frexp(x):
+    """Mantissa/exponent decomposition (paddle.frexp)."""
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (paddle arg order)."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def multigammaln(x, p):
+    import math as _m
+    c = p * (p - 1) / 4.0 * _m.log(_m.pi)
+    x = jnp.asarray(x)[..., None]
+    i = jnp.arange(p, dtype=jnp.result_type(x, jnp.float32))
+    return c + jnp.sum(jax.scipy.special.gammaln(x - i / 2.0), axis=-1)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def positive(x):
+    return +x
+
+
+def negative(x):
+    return -x
+
+
+def fmod(x, y):
+    return jnp.fmod(x, y)
+
+
+def xlogy(x, y):
+    return jax.scipy.special.xlogy(x, y)
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def erfcx(x):
+    return jnp.exp(jnp.square(x)) * jax.scipy.special.erfc(x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def nanargmax(x, axis=None, keepdim=False):
+    out = jnp.nanargmax(x, axis=axis)
+    return jnp.expand_dims(out, axis) if (keepdim and axis is not None) \
+        else out
+
+
+def nanargmin(x, axis=None, keepdim=False):
+    out = jnp.nanargmin(x, axis=axis)
+    return jnp.expand_dims(out, axis) if (keepdim and axis is not None) \
+        else out
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def vdot(x, y):
+    return jnp.vdot(x, y)
+
+
+def msort(x):
+    return jnp.sort(x, axis=0)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0):
+    r = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(input, bins=bins, range=r)

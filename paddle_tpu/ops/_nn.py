@@ -451,6 +451,39 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return out
 
 
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    n = 3
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _conv_padding(padding, n, s, (1, 1, 1), k)
+    pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    out = lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        lax.max, (1, 1) + k, (1, 1) + s, pads)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format="NCDHW"):
+    n = 3
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _conv_padding(padding, n, s, (1, 1, 1), k)
+    pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                               pads)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive and not isinstance(pads, str):
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                   (1, 1) + k, (1, 1) + s, pads)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
     n = 2
@@ -934,3 +967,306 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
 def fused_linear(x, weight, bias=None, transpose_weight=False):
     w = weight.T if transpose_weight else weight
     return linear(x, w, bias)
+
+
+# -- round-4 long-tail batch: losses / pools / misc (VERDICT r3 #3) ---------
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1)
+        * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1.0, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):
+    return _reduce(jnp.maximum(0.0, -label * (input - other) + margin),
+                   reduction)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = jnp.linalg.norm(x - y + epsilon, ord=p, axis=-1,
+                        keepdims=keepdim)
+    return d
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    dp = pairwise_distance(input, positive, p, epsilon)
+    dn = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        dn = jnp.minimum(dn, pairwise_distance(positive, negative, p,
+                                               epsilon))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + epsilon) - label
+                    + 0.5 * jnp.log(2.0 * np.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2.0 * np.pi))
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank=0, reduction="mean", norm_by_times=False):
+    """CTC loss via the standard log-semiring forward DP, scanned over
+    time (paddle: log_probs [T, B, C] logits, labels [B, L] int).
+    Returns per-sequence negative log likelihood, reduced."""
+    t_max, b, _ = log_probs.shape
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    l_max = labels.shape[1]
+    s = 2 * l_max + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    neg = jnp.float32(-1e30)
+    # alpha init: positions 0 (blank) and 1 (first label)
+    a0 = jnp.full((b, s), neg)
+    a0 = a0.at[:, 0].set(lp[0, jnp.arange(b), ext[:, 0]])
+    a0 = a0.at[:, 1].set(jnp.where(
+        label_lengths > 0, lp[0, jnp.arange(b), ext[:, 1]], neg))
+
+    same = jnp.concatenate(
+        [jnp.ones((b, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)      # skip-path blocked
+
+    def step(alpha, lp_t):
+        prev1 = jnp.concatenate([jnp.full((b, 1), neg),
+                                 alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((b, 2), neg),
+                                 alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(same, neg, prev2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return merged + emit, merged
+
+    ts = jnp.arange(1, t_max)
+
+    def scan_body(carry, ti):
+        alpha = carry
+        new, _ = step(alpha, lp[ti])
+        # sequences shorter than t keep their final alpha
+        keep = (ti < input_lengths)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    alpha, _ = jax.lax.scan(scan_body, a0, ts)
+    # NLL = -logaddexp(alpha[L*2], alpha[L*2-1]) at t = len-1
+    idx_last = 2 * label_lengths.astype(jnp.int32)
+    bidx = jnp.arange(b)
+    end1 = alpha[bidx, idx_last]
+    end2 = jnp.where(label_lengths > 0,
+                     alpha[bidx, jnp.maximum(idx_last - 1, 0)], neg)
+    nll = -jnp.logaddexp(end1, end2)
+    if norm_by_times:
+        nll = nll / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # paddle divides each sequence's NLL by its label length first
+        return jnp.mean(
+            nll / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
+    return _reduce(nll, reduction)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    l, r, t_, b_ = _norm_tuple(padding, 4)
+    return jnp.pad(x, [(0, 0), (0, 0), (t_, b_), (l, r)])
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    padded = jnp.pad(sq, pad)
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate([xr[:, 1:, :fold],
+                            jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                           xr[:, :-1, fold:2 * fold]], axis=1)
+    rest = xr[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, rest],
+                           axis=2).reshape(nt, c, h, w)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False):
+    if training:
+        # per-element slope from the library's seeded keyed RNG (a
+        # host-side scalar would bake one constant slope under jit)
+        a = jax.random.uniform(_random.split_key(), x.shape,
+                               minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    x4 = x[:, :, None, :]
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _norm_tuple(padding, 1)[0]
+    return max_pool2d(x4, (1, k), (1, s), (0, p))[:, :, 0, :]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    x4 = x[:, :, None, :]
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _norm_tuple(padding, 1)[0]
+    return avg_pool2d(x4, (1, k), (1, s), (0, p),
+                      exclusive=exclusive)[:, :, 0, :]
+
+
+def adaptive_avg_pool1d(x, output_size):
+    x4 = x[:, :, None, :]
+    return adaptive_avg_pool2d(x4, (1, output_size))[:, :, 0, :]
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    x4 = x[:, :, None, :]
+    return adaptive_max_pool2d(x4, (1, output_size))[:, :, 0, :]
+
+
+def _adaptive_pool3d(x, output_size, reduce_fn):
+    od, oh, ow = _norm_tuple(output_size, 3)
+    d = x.shape[2]
+    outs = []
+    for i in range(od):
+        d0, d1 = (i * d) // od, -(-((i + 1) * d) // od)
+        plane = reduce_fn(x[:, :, d0:d1], axis=2)
+        outs.append(plane)
+    planes = jnp.stack(outs, axis=2)   # [N, C, od, H, W]
+    n, c, od_, h, w = planes.shape
+    flat = planes.reshape(n, c * od_, h, w)
+    pooled = _adaptive_pool2d(flat, (oh, ow), reduce_fn)
+    return pooled.reshape(n, c, od_, oh, ow)
+
+
+def adaptive_avg_pool3d(x, output_size):
+    return _adaptive_pool3d(x, output_size, jnp.mean)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    return _adaptive_pool3d(x, output_size, jnp.max)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False):
+    p = float(norm_type)
+    k = _norm_tuple(kernel_size, 1)[0]
+    s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
+    summed = avg_pool1d(jnp.power(jnp.abs(x), p), k, s, padding,
+                        exclusive=False) * k
+    return jnp.power(summed, 1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    p = float(norm_type)
+    k = _norm_tuple(kernel_size, 2)
+    summed = avg_pool2d(jnp.power(jnp.abs(x), p), k, stride, padding,
+                        exclusive=False) * float(np.prod(k))
+    return jnp.power(summed, 1.0 / p)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    """Scatter pooled values back to their argmax positions.  indices:
+    flat positions within each (N, C) plane (paddle's convention)."""
+    k = _norm_tuple(kernel_size, 2)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    n, c, h, w = x.shape
+    if output_size is None:
+        oh = (h - 1) * s[0] + k[0] - 2 * _norm_tuple(padding, 2)[0]
+        ow = (w - 1) * s[1] + k[1] - 2 * _norm_tuple(padding, 2)[1]
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, h * w).astype(jnp.int32)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        idx].set(x.reshape(n, c, h * w))
+    return flat.reshape(n, c, oh, ow)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean"):
+    """Gather + segment-reduce (paddle/torch embedding_bag, 2D input
+    form: input [B, L] -> [B, D] reduced embeddings).  The ragged
+    1D+offsets form is not supported — reject it rather than reduce
+    over the wrong axis."""
+    if offsets is not None or input.ndim != 2:
+        raise NotImplementedError(
+            "embedding_bag supports the 2D input form only "
+            "(input [B, L], offsets=None)")
+    emb = weight[input]                       # [B, L, D]
+    if mode == "sum":
+        return jnp.sum(emb, axis=1)
+    if mode == "max":
+        return jnp.max(emb, axis=1)
+    return jnp.mean(emb, axis=1)

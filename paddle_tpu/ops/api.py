@@ -46,7 +46,8 @@ for _mod in (_math, _reduction, _manipulation, _creation, _search, _linalg,
 # random ops keep their stateful raw forms but still return Tensors
 for _name in ("rand", "randn", "randint", "uniform", "normal",
               "standard_normal", "bernoulli", "multinomial", "randperm",
-              "shuffle", "gumbel", "gumbel_softmax"):
+              "shuffle", "gumbel", "gumbel_softmax", "poisson",
+              "standard_gamma", "binomial"):
     if hasattr(_random, _name):
         _NS[_name] = tensorize(getattr(_random, _name))
 
@@ -104,6 +105,54 @@ def equal_all(x, y):
 
 _NS["equal_all"] = equal_all
 TENSOR_METHODS["equal_all"] = equal_all
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def rank(x):
+    return to_tensor(len(x.shape))
+
+
+def numel(x):
+    import numpy as _np
+    return to_tensor(int(_np.prod(x.shape)) if len(x.shape) else 1)
+
+
+def is_empty(x):
+    import numpy as _np
+    return to_tensor(int(_np.prod(x.shape)) == 0)
+
+
+def clone(x):
+    return apply_op(lambda a: a + 0, x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Mark entries of a sharded index range (paddle.shard_index)."""
+    import jax.numpy as _jnp
+    size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+
+    def raw(a):
+        inside = (a >= lo) & (a < hi)
+        return _jnp.where(inside, a - lo, ignore_value)
+    return apply_op(raw, input)
+
+
+for _n in ("is_tensor", "rank", "numel", "is_empty", "clone",
+           "broadcast_shape", "shard_index"):
+    _NS[_n] = globals()[_n]
+    if _n not in __all__:
+        __all__.append(_n)
+for _n in ("rank", "numel", "is_empty", "clone"):
+    TENSOR_METHODS[_n] = _NS[_n]
 
 
 for _name in ("add", "subtract", "multiply", "divide", "clip", "scale",

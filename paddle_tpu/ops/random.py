@@ -137,6 +137,21 @@ def bernoulli(x):
     return jax.random.bernoulli(split_key(), p=x, shape=x.shape).astype(x.dtype)
 
 
+def poisson(x):
+    """Per-element Poisson samples with rate ``x`` (paddle.poisson)."""
+    return jax.random.poisson(split_key(), x).astype(x.dtype)
+
+
+def standard_gamma(x):
+    return jax.random.gamma(split_key(), x).astype(x.dtype)
+
+
+def binomial(count, prob):
+    return jax.random.binomial(
+        split_key(), count.astype(jnp.float32),
+        prob.astype(jnp.float32)).astype(jnp.int32)
+
+
 def multinomial(x, num_samples=1, replacement=False):
     key = split_key()
     logits = jnp.log(jnp.clip(x, 1e-30, None))

@@ -3,7 +3,7 @@
 1. a PaddleNLP-style recipe script (model build → finetune loop with
    clip + scheduler + amp → generate → save/load) runs end-to-end;
 2. a sweep that EXECUTES the public op surface with synthesized
-   arguments — ≥400 distinct public callables must run without
+   arguments — ≥450 distinct public callables must run without
    NotImplementedError.
 """
 import inspect
@@ -136,6 +136,65 @@ def _special_cases(e):
         "numel": lambda: paddle.numel(M),
         "rank": lambda: paddle.rank(M),
         "shard_index": lambda: paddle.shard_index(I, 20, 2, 0),
+        # round-4 long-tail batch
+        "vsplit": lambda: paddle.vsplit(M, 2),
+        "hsplit": lambda: paddle.hsplit(M, 2),
+        "dsplit": lambda: paddle.dsplit(IMG, 2),
+        "tensor_split": lambda: paddle.tensor_split(M, 2),
+        "column_stack": lambda: paddle.column_stack([M, M]),
+        "row_stack": lambda: paddle.row_stack([M, M]),
+        "dstack": lambda: paddle.dstack([M, M]),
+        "broadcast_tensors": lambda: paddle.broadcast_tensors([M, M]),
+        "broadcast_shape": lambda: paddle.broadcast_shape([2, 3], [3]),
+        "multigammaln": lambda: paddle.multigammaln(
+            paddle.abs(M) + 3.0, 2),
+        "baddbmm": lambda: paddle.baddbmm(
+            t(e["rng"].standard_normal((2, 3, 3))),
+            t(e["rng"].standard_normal((2, 3, 4))),
+            t(e["rng"].standard_normal((2, 4, 3)))),
+        "gammainc": lambda: paddle.gammainc(paddle.abs(M) + 0.5, P),
+        "gammaincc": lambda: paddle.gammaincc(paddle.abs(M) + 0.5, P),
+        "binomial": lambda: paddle.binomial(
+            paddle.full([3], 5.0), P[0, :3]),
+        "ctc_loss": lambda: F.ctc_loss(
+            t(e["rng"].standard_normal((6, 2, 5))),
+            t(_np.array([[1, 2], [3, 0]]), "int32"),
+            t(_np.array([6, 6]), "int32"),
+            t(_np.array([2, 1]), "int32")),
+        "cosine_embedding_loss": lambda: F.cosine_embedding_loss(
+            M, M, paddle.ones([4])),
+        "margin_ranking_loss": lambda: F.margin_ranking_loss(
+            V, V, paddle.ones([8])),
+        "triplet_margin_loss": lambda: F.triplet_margin_loss(M, M, M),
+        "triplet_margin_with_distance_loss":
+            lambda: F.triplet_margin_with_distance_loss(M, M, M),
+        "gaussian_nll_loss": lambda: F.gaussian_nll_loss(M, M, P),
+        "zeropad2d": lambda: F.zeropad2d(IMG, 1),
+        "local_response_norm": lambda: F.local_response_norm(IMG, 2),
+        "temporal_shift": lambda: F.temporal_shift(IMG, 2),
+        "max_pool1d": lambda: F.max_pool1d(
+            t(e["rng"].standard_normal((2, 3, 8))), 2),
+        "avg_pool1d": lambda: F.avg_pool1d(
+            t(e["rng"].standard_normal((2, 3, 8))), 2),
+        "adaptive_avg_pool1d": lambda: F.adaptive_avg_pool1d(
+            t(e["rng"].standard_normal((2, 3, 8))), 2),
+        "adaptive_max_pool1d": lambda: F.adaptive_max_pool1d(
+            t(e["rng"].standard_normal((2, 3, 8))), 2),
+        "max_pool3d": lambda: F.max_pool3d(
+            t(e["rng"].standard_normal((1, 2, 4, 4, 4))), 2),
+        "avg_pool3d": lambda: F.avg_pool3d(
+            t(e["rng"].standard_normal((1, 2, 4, 4, 4))), 2),
+        "adaptive_avg_pool3d": lambda: F.adaptive_avg_pool3d(
+            t(e["rng"].standard_normal((1, 2, 4, 4, 4))), 2),
+        "adaptive_max_pool3d": lambda: F.adaptive_max_pool3d(
+            t(e["rng"].standard_normal((1, 2, 4, 4, 4))), 2),
+        "lp_pool1d": lambda: F.lp_pool1d(
+            t(e["rng"].standard_normal((2, 3, 8))), 2, 2),
+        "lp_pool2d": lambda: F.lp_pool2d(IMG, 2, 2),
+        "max_unpool2d": lambda: F.max_unpool2d(
+            t(e["rng"].standard_normal((1, 2, 2, 2))),
+            t(_np.arange(8).reshape(1, 2, 2, 2) % 16, "int32"), 2),
+        "embedding_bag": lambda: F.embedding_bag(I, M),
         "set_flags": lambda: paddle.set_flags(
             {"FLAGS_check_nan_inf": False}),
         "get_flags": lambda: paddle.get_flags(["FLAGS_check_nan_inf"]),
@@ -442,7 +501,7 @@ def _special_cases(e):
     }
 
 
-def test_op_surface_sweep_400():
+def test_op_surface_sweep_450():
     e = _mk()
     special = _special_cases(e)
     M, V, P, I = e["M"], e["V"], e["P"], e["I"]
@@ -501,5 +560,5 @@ def test_op_surface_sweep_400():
                 not_run.append(prefix + name)
 
     assert not broken, f"ops raised NotImplementedError: {broken}"
-    assert len(ran) >= 400, (
+    assert len(ran) >= 450, (
         f"only {len(ran)} public ops executed; unrunnable: {not_run}")

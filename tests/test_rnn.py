@@ -1,0 +1,231 @@
+"""RNN family (SimpleRNN/LSTM/GRU + cells) vs numpy oracles, plus the
+round-4 zoo additions (MaxPool3D/AvgPool3D, SpectralNorm).
+Reference parity: python/paddle/nn/layer/rnn.py (SURVEY.md §2.2 nn row).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_cell(mode, x_t, h, c, wi, wh, bi, bh):
+    if mode == "gru":
+        gx = x_t @ wi.T + bi
+        gh = h @ wh.T + bh
+        H = h.shape[-1]
+        r = _sigmoid(gx[:, :H] + gh[:, :H])
+        z = _sigmoid(gx[:, H:2 * H] + gh[:, H:2 * H])
+        cand = np.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        h = z * h + (1 - z) * cand
+        return h, h, c
+    g = x_t @ wi.T + bi + h @ wh.T + bh
+    if mode == "lstm":
+        H = h.shape[-1]
+        i, f, cc, o = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H],
+                       g[:, 3 * H:])
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(cc)
+        h = _sigmoid(o) * np.tanh(c)
+        return h, h, c
+    act = np.tanh if mode == "rnn_tanh" else lambda v: np.maximum(v, 0)
+    h = act(g)
+    return h, h, c
+
+
+def _np_rnn(mode, x, lens, wi, wh, bi, bh, reverse=False):
+    """Oracle single (layer, direction) with paddle's masking/reversal
+    semantics: y [B,T,H], h_T, c_T."""
+    b, t, _ = x.shape
+    H = wh.shape[1]
+    y = np.zeros((b, t, H), np.float64)
+    h = np.zeros((b, H), np.float64)
+    c = np.zeros((b, H), np.float64)
+    for bi_ in range(b):
+        L = int(lens[bi_])
+        hh = np.zeros((1, H))
+        cc = np.zeros((1, H))
+        order = range(L - 1, -1, -1) if reverse else range(L)
+        for ti in order:
+            out, hh, cc = _np_cell(mode, x[bi_:bi_ + 1, ti], hh, cc,
+                                   wi, wh, bi, bh)
+            y[bi_, ti] = out[0]
+        h[bi_] = hh[0]
+        c[bi_] = cc[0]
+    return y, h, c
+
+
+def _weights(layer, k=0):
+    cell = layer.cells[k]
+    return (np.asarray(cell.weight_ih.numpy(), np.float64),
+            np.asarray(cell.weight_hh.numpy(), np.float64),
+            np.asarray(cell.bias_ih.numpy(), np.float64),
+            np.asarray(cell.bias_hh.numpy(), np.float64))
+
+
+@pytest.mark.parametrize("cls,mode", [(nn.SimpleRNN, "rnn_tanh"),
+                                      (nn.LSTM, "lstm"),
+                                      (nn.GRU, "gru")])
+def test_rnn_matches_numpy_oracle_with_lengths(cls, mode):
+    rng = np.random.default_rng(0)
+    b, t, i, h = 3, 7, 5, 6
+    layer = cls(i, h)
+    x = rng.standard_normal((b, t, i)).astype(np.float32)
+    lens = np.array([7, 4, 1], np.int32)
+    out, states = layer(paddle.to_tensor(x),
+                        sequence_length=paddle.to_tensor(lens))
+    wi, wh, bi, bh = _weights(layer)
+    want_y, want_h, want_c = _np_rnn(mode, x.astype(np.float64), lens,
+                                     wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want_y,
+                               atol=1e-5, rtol=1e-5)
+    h_last = states[0] if mode == "lstm" else states
+    np.testing.assert_allclose(np.asarray(h_last.numpy())[0], want_h,
+                               atol=1e-5, rtol=1e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(np.asarray(states[1].numpy())[0],
+                                   want_c, atol=1e-5, rtol=1e-5)
+
+
+def test_bidirectional_gru_matches_oracle():
+    rng = np.random.default_rng(1)
+    b, t, i, h = 2, 6, 4, 5
+    layer = nn.GRU(i, h, direction="bidirect")
+    x = rng.standard_normal((b, t, i)).astype(np.float32)
+    lens = np.array([6, 3], np.int32)
+    out, states = layer(paddle.to_tensor(x),
+                        sequence_length=paddle.to_tensor(lens))
+    assert tuple(out.shape) == (b, t, 2 * h)
+    wf = _weights(layer, 0)
+    wb = _weights(layer, 1)
+    yf, hf, _ = _np_rnn("gru", x.astype(np.float64), lens, *wf)
+    yb, hb, _ = _np_rnn("gru", x.astype(np.float64), lens, *wb,
+                        reverse=True)
+    got = np.asarray(out.numpy())
+    np.testing.assert_allclose(got[:, :, :h], yf, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got[:, :, h:], yb, atol=1e-5, rtol=1e-5)
+    st = np.asarray(states.numpy())
+    np.testing.assert_allclose(st[0], hf, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(st[1], hb, atol=1e-5, rtol=1e-5)
+
+
+def test_stacked_lstm_shapes_and_grads():
+    rng = np.random.default_rng(2)
+    b, t, i, h = 2, 5, 4, 8
+    layer = nn.LSTM(i, h, num_layers=2, direction="bidirectional")
+    x = paddle.to_tensor(rng.standard_normal((b, t, i)).astype(
+        np.float32), stop_gradient=False)
+    out, (hn, cn) = layer(x)
+    assert tuple(out.shape) == (b, t, 2 * h)
+    assert tuple(hn.shape) == (4, b, h) and tuple(cn.shape) == (4, b, h)
+    loss = (out * out).sum() + (hn * hn).sum()
+    loss.backward()
+    for name, p in layer.named_parameters():
+        assert p.grad is not None, name
+        g = np.asarray(p.grad.numpy())
+        assert np.isfinite(g).all(), name
+    assert np.abs(np.asarray(x.grad.numpy())).sum() > 0
+
+
+def test_cells_match_layer_single_step():
+    rng = np.random.default_rng(3)
+    b, i, h = 4, 3, 5
+    cell = nn.LSTMCell(i, h)
+    x = paddle.to_tensor(rng.standard_normal((b, i)).astype(np.float32))
+    out, (hn, cn) = cell(x)
+    wi = np.asarray(cell.weight_ih.numpy(), np.float64)
+    wh = np.asarray(cell.weight_hh.numpy(), np.float64)
+    bi = np.asarray(cell.bias_ih.numpy(), np.float64)
+    bh = np.asarray(cell.bias_hh.numpy(), np.float64)
+    _, want_h, want_c = _np_cell(
+        "lstm", np.asarray(x.numpy(), np.float64), np.zeros((b, h)),
+        np.zeros((b, h)), wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(hn.numpy()), want_h,
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(cn.numpy()), want_c,
+                               atol=1e-5, rtol=1e-5)
+    # the generic RNN wrapper runs the same cell over time
+    wrapped = nn.RNN(cell)
+    xs = paddle.to_tensor(rng.standard_normal((b, 4, i)).astype(
+        np.float32))
+    y, (hT, cT) = wrapped(xs)
+    assert tuple(y.shape) == (b, 4, h)
+    # BiRNN concat
+    bi_rnn = nn.BiRNN(nn.GRUCell(i, h), nn.GRUCell(i, h))
+    yb, _ = bi_rnn(xs)
+    assert tuple(yb.shape) == (b, 4, 2 * h)
+
+
+def test_time_major_and_relu_activation():
+    rng = np.random.default_rng(4)
+    b, t, i, h = 2, 5, 3, 4
+    layer = nn.SimpleRNN(i, h, activation="relu", time_major=True)
+    x = rng.standard_normal((t, b, i)).astype(np.float32)
+    out, _ = layer(paddle.to_tensor(x))
+    assert tuple(out.shape) == (t, b, h)
+    lens = np.full((b,), t, np.int32)
+    wi, wh, bi, bh = _weights(layer)
+    want, _, _ = _np_rnn("rnn_relu",
+                         np.swapaxes(x, 0, 1).astype(np.float64), lens,
+                         wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.swapaxes(want, 0, 1), atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_pool3d_layers():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 3, 8, 8, 8)).astype(np.float32)
+    mp = nn.MaxPool3D(2)(paddle.to_tensor(x))
+    ap = nn.AvgPool3D(2)(paddle.to_tensor(x))
+    assert tuple(mp.shape) == (2, 3, 4, 4, 4)
+    want = x.reshape(2, 3, 4, 2, 4, 2, 4, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(np.asarray(mp.numpy()), want, atol=1e-6)
+    want_a = x.reshape(2, 3, 4, 2, 4, 2, 4, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(np.asarray(ap.numpy()), want_a,
+                               atol=1e-6)
+
+
+def test_ctc_loss_matches_torch():
+    """CTC forward DP vs torch's reference implementation (logits in —
+    paddle applies log_softmax internally, torch takes log-probs)."""
+    import torch
+
+    rng = np.random.default_rng(7)
+    t_max, b, c, l_max = 12, 3, 6, 4
+    logits = rng.standard_normal((t_max, b, c)).astype(np.float32)
+    labels = rng.integers(1, c, (b, l_max)).astype(np.int32)
+    in_lens = np.array([12, 9, 7], np.int32)
+    lab_lens = np.array([4, 3, 1], np.int32)
+
+    F = paddle.nn.functional
+    got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_lens),
+                     paddle.to_tensor(lab_lens), reduction="none")
+
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_lens.astype(np.int64)),
+        torch.tensor(lab_lens.astype(np.int64)),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               tl.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_spectral_norm_power_iteration():
+    rng = np.random.default_rng(6)
+    w = rng.standard_normal((6, 4)).astype(np.float32)
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=30)
+    out = sn(paddle.to_tensor(w))
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.asarray(out.numpy()), w / sigma,
+                               atol=1e-4, rtol=1e-4)
+    # buffers persist (warm start) and live in state_dict
+    assert "weight_u" in dict(sn.named_buffers())
+    u1 = np.asarray(sn.weight_u.numpy()).copy()
+    sn(paddle.to_tensor(w))
+    assert not np.allclose(u1, np.zeros_like(u1))
