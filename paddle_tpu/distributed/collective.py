@@ -329,6 +329,7 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
                 tensor_or_list.extend(Tensor(res[i])
                                       for i in range(res.shape[0]))
                 return
+        _warn_concrete_identity("all_gather", group)
         tensor_or_list.extend(Tensor(val) for _ in range(group.nranks))
         return
     val = _unwrap(tensor_or_list)
@@ -343,6 +344,7 @@ def all_gather(tensor_or_list, tensor=None, group: Optional[CommGroup] = None,
         if res is not None:
             return Tensor(res) if isinstance(tensor_or_list, Tensor) \
                 else res
+    _warn_concrete_identity("all_gather", group)
     return tensor_or_list
 
 
@@ -396,6 +398,8 @@ def broadcast(tensor, src: int = 0, group=None, sync_op: bool = True):
             return tensor
         return Tensor(res) if isinstance(tensor, Tensor) else res
     # single controller SPMD: one logical value — broadcast is identity
+    if not _is_traced(val):
+        _warn_concrete_identity("broadcast", group)
     return tensor
 
 
@@ -403,7 +407,12 @@ def scatter(tensor, tensor_list=None, src: int = 0,
             group: Optional[CommGroup] = None, sync_op: bool = True):
     group = group or _default_group()
     if tensor_list is not None:
-        return Tensor(_unwrap(tensor_list[0]))
+        val = _unwrap(tensor_list[0])
+        if not _is_traced(val):
+            _warn_concrete_identity("scatter", group)
+        return Tensor(val)
+    if not _is_traced(_unwrap(tensor)):
+        _warn_concrete_identity("scatter", group)
     return tensor
 
 

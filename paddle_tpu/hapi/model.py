@@ -62,9 +62,18 @@ class Model:
         self._loss = loss
         # re-preparing drops any compiled step: optimizer/loss/metrics
         # are baked into it (incl. the has_aux choice), so a stale step
-        # would silently ignore the new configuration
+        # would silently ignore the new configuration.  If the
+        # OPTIMIZER object is unchanged, its accumulated state
+        # (moments, loaded via load()) carries over into the rebuilt
+        # step — silently resetting it was ADVICE r3 (e.g. a metrics
+        # tweak mid-training zeroing Adam moments)
         if self._train_step is not None:
-            self._pending_opt_state = None
+            if optimizer is not None and optimizer is getattr(
+                    self._train_step, "optimizer", None):
+                self._pending_opt_state = self._train_step.state.get(
+                    "opt")
+            else:
+                self._pending_opt_state = None
             self._train_step = None
         self._metrics = _as_list(metrics)
         for m in self._metrics:

@@ -353,10 +353,11 @@ class LLMEngine:
         return rid
 
     # -- decode loop -----------------------------------------------------------
-    def step(self) -> Dict[object, int]:
+    def step(self) -> Dict[object, List[int]]:
         """Decode up to ``steps_per_sync`` tokens for every active
-        request in one device dispatch; returns {request_id:
-        last_new_token} and retires finished requests.  The host only
+        request in one device dispatch; returns {request_id: [new
+        tokens this window]} and retires finished requests (streaming
+        callers see every intermediate token).  The host only
         syncs (EOS checks, admission window) once per call, so over a
         high-latency dispatch path (remote PJRT) throughput scales with
         steps_per_sync; the window never exceeds any request's
@@ -405,19 +406,25 @@ class LLMEngine:
         self.cache.advance(slots, nsteps)
         toks = np.asarray(jax.device_get(toks))[:, :n]   # [nsteps, n]
 
+        # contract (ADVICE r3): with steps_per_sync > 1 a window emits
+        # up to nsteps tokens per request — return the LIST of new
+        # tokens per rid so streaming callers never lose intermediates
         out = {}
         for i, req in enumerate(batch):
+            new_toks = []
             for j in range(nsteps):
                 if req.done:
                     break
                 tok = int(toks[j, i])
                 req.out.append(tok)
-                out[req.rid] = tok
+                new_toks.append(tok)
                 if (req.eos is not None and tok == req.eos) or \
                         len(req.out) >= req.max_new:
                     req.done = True
                     self.cache.release(req.slot)
                     self._active.remove(req)
+            if new_toks:
+                out[req.rid] = new_toks
         return out
 
     def has_work(self) -> bool:

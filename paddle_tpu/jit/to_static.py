@@ -23,13 +23,19 @@ The returned StaticFunction:
     execution, the signature is remembered as a fallback (no re-trace
     attempts), and the break is logged + counted
     (``.graph_break_count``).  ``full_graph=True`` keeps the strict
-    contract and re-raises.  Divergence from SOT to know about: SOT
-    splits at the break point and never re-runs the prefix, while this
-    fallback re-executes the WHOLE function eagerly — on the one
-    breaking call, python side effects before the break (prints, list
-    appends) run twice; tensor/layer state is unaffected
-    (functional_state and rng_guard unwind the aborted trace), and
-    subsequent same-signature calls go straight to eager.
+    contract and re-raises.
+  * **compiled-prefix capture** (round 4, SOT's compiled-segment
+    behavior): the breaking call records the pre-break op stream while
+    running eagerly; subsequent same-signature no-grad calls execute
+    the prefix as ONE jitted XLA program and substitute its results
+    op-by-op under guards (jit/prefix.py), so only the post-break tail
+    pays eager dispatch.  Stats: ``prefix_op_count``,
+    ``prefix_replay_count``, ``last_replayed_ops``.  Under grad mode
+    the whole signature stays plainly eager (the tape needs per-op
+    vjps).  On the one breaking call, python side effects before the
+    break run twice (the aborted trace + the recording run);
+    tensor/layer state is unaffected (functional_state and rng_guard
+    unwind the aborted trace).
 
 Known functional-purity caveat (documented parity gap): BatchNorm
 running-stat mutation inside a to_static region is reverted at trace
@@ -100,7 +106,14 @@ class StaticFunction:
         self._cache = {}
         self._full_graph = full_graph
         self._fallback_keys = set()
+        self._prefix_cache = {}
         self.graph_break_count = 0
+        # prefix-capture stats (SOT parity): ops compiled into the
+        # prefix segment / calls served by its compiled replay / ops
+        # substituted on the most recent replayed call
+        self.prefix_op_count = 0
+        self.prefix_replay_count = 0
+        self.last_replayed_ops = 0
         functools.update_wrapper(self, function)
 
     def __get__(self, instance, owner):
@@ -139,7 +152,10 @@ class StaticFunction:
             key = None
 
         if key is not None and key in self._fallback_keys:
-            return self._function(*args, **kwargs)   # known graph-break
+            # known graph-break: eager, with the compiled prefix
+            # replayed when one was captured for this signature
+            return self._eager_with_prefix(key, args, kwargs, flat_args,
+                                           tensor_idx)
 
         entry = self._cache.get(key) if key is not None else None
         if entry is None:
@@ -196,13 +212,98 @@ class StaticFunction:
                 self._cache.pop(key, None)
             import logging
             logging.getLogger("paddle_tpu.jit").warning(
-                "to_static graph break in %r (falling back to eager for "
-                "this signature): %s",
+                "to_static graph break in %r (compiled-prefix capture + "
+                "eager tail for this signature): %s",
                 getattr(self._function, "__name__", "?"),
                 str(e).splitlines()[0] if str(e) else type(e).__name__)
-            return self._function(*args, **kwargs)
+            return self._eager_with_prefix(key, args, kwargs, flat_args,
+                                           tensor_idx)
         flat_out = list(out) if isinstance(out, (tuple, list)) else [out]
         return jax.tree_util.tree_unflatten(out_tree_box["tree"], flat_out)
+
+    def _eager_with_prefix(self, key, args, kwargs, flat_args,
+                           tensor_idx):
+        """Eager execution of a graph-broken signature, with SOT-style
+        compiled-prefix capture: the first eager run records the
+        pre-break op stream; later runs replay it as ONE jitted call
+        and substitute its results op-by-op (see jit/prefix.py).
+        Only NON-diff ops are captured (the recorder closes the prefix
+        at the first grad-path op — the eager tape wants per-op vjps
+        that substituted results don't carry), and the prefix cache is
+        keyed on the arg stop-gradient flags + grad mode so an op's
+        diff-ness cannot differ between recording and replay."""
+        from ..autograd import tape
+        from ..tensor import set_op_observer
+        from .prefix import (PrefixRecorder, PrefixReplayer,
+                             build_prefix_replay)
+
+        layer = self._layer
+        if key is None:
+            return self._function(*args, **kwargs)
+        key = (key,
+               tuple(bool(getattr(flat_args[i], "stop_gradient", True))
+                     for i in tensor_idx),
+               tape.is_grad_enabled())
+
+        entry = self._prefix_cache.get(key)
+        if entry is False:          # evicted: guards kept bailing
+            return self._function(*args, **kwargs)
+        if entry is None:
+            ext_sources = {}
+            if layer is not None:
+                for n, p in layer.named_parameters():
+                    ext_sources[id(p.value)] = ("param", n)
+                for n, b in layer.named_buffers():
+                    ext_sources[id(b.value)] = ("buffer", n)
+            for i in tensor_idx:
+                a = flat_args[i]
+                ext_sources[id(a.value if isinstance(a, Tensor)
+                               else a)] = ("arg", i)
+            rec = PrefixRecorder(ext_sources)
+            prev = set_op_observer(rec)
+            try:
+                out = self._function(*args, **kwargs)
+            finally:
+                set_op_observer(prev)
+            if rec.ops:
+                self._prefix_cache[key] = (rec, build_prefix_replay(rec))
+                self.prefix_op_count = len(rec.ops)
+                rec.seal()
+            else:
+                self._prefix_cache[key] = False     # nothing capturable
+            return out
+
+        rec, jitted = entry
+        named = dict(layer.named_parameters()) if layer is not None \
+            else {}
+        bufs = dict(layer.named_buffers()) if layer is not None else {}
+
+        def fetch(desc):
+            kind, ref = desc
+            if kind == "param":
+                return named[ref].value
+            if kind == "buffer":
+                return bufs[ref].value
+            if kind == "arg":
+                a = flat_args[ref]
+                return a.value if isinstance(a, Tensor) else a
+            return rec.consts[ref]                    # const
+
+        ext_arrays = [fetch(d) for d in rec.ext_desc]
+        prefix_flat = jitted(ext_arrays)
+        rep = PrefixReplayer(rec, prefix_flat, ext_arrays)
+        prev = set_op_observer(rep)
+        try:
+            out = self._function(*args, **kwargs)
+        finally:
+            set_op_observer(prev)
+        self.prefix_replay_count += 1
+        self.last_replayed_ops = rep.replayed
+        if rep.replayed < max(1, len(rec.ops) // 2):
+            # guards bailed early: running the whole compiled prefix
+            # then recomputing most of it eagerly costs ~2x — evict
+            self._prefix_cache[key] = False
+        return out
 
     @property
     def function(self):

@@ -713,6 +713,11 @@ def flash_attention_raw(q, k, v, causal: bool = False, mask=None,
     """
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
+    if not 0.0 <= dropout_p < 1.0:
+        # the kernel's keep-threshold is a uint32 compare: p >= 1 would
+        # clamp to keep-with-prob-2^-32 and the 1/(1-p) rescale
+        # divides by zero (ADVICE r3)
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
     if causal and sq > sk:
         raise NotImplementedError("causal flash kernel needs sq <= sk")
     if d not in (64, 128, 256) or h % hk or sq % 8 or sk % 8:

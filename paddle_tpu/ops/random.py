@@ -84,6 +84,10 @@ def rng_guard(key):
 
 def split_key():
     """Get a fresh subkey (from the active rng_guard, else the global key)."""
+    # any RNG draw closes a to_static compiled-prefix recording: a
+    # replayed prefix would freeze the recorded key as a jit constant
+    from ..tensor import _notify_host_read
+    _notify_host_read()
     box = getattr(_state, "box", None)
     if box is not None:
         return box.split()

@@ -154,10 +154,12 @@ class Tensor:
 
     # -- value access / mutation -------------------------------------------
     def numpy(self) -> np.ndarray:
+        _notify_host_read()
         return np.asarray(self._value)
 
     def item(self):
         enforce(self.size == 1, "item() requires a single-element tensor")
+        _notify_host_read()
         return self._value.reshape(()).item()
 
     def tolist(self):
@@ -228,6 +230,7 @@ class Tensor:
 
     def __bool__(self):
         enforce(self.size == 1, "truth value of multi-element tensor is ambiguous")
+        _notify_host_read()
         return bool(self._value)
 
     def __int__(self):
@@ -341,6 +344,27 @@ def rebuild_from_template(template, arrs):
     return out
 
 
+# --- op observer: jit/to_static's compiled-prefix capture hook ------------
+# Set via set_op_observer for the duration of one StaticFunction call:
+# records the op stream (recorder) or substitutes precomputed prefix
+# results (replayer).  Observed ops are the NON-diff eager path only —
+# a diff-path op or a Tensor host read notifies the observer instead.
+_OP_OBSERVER = None
+OBS_MISS = object()
+
+
+def set_op_observer(obs):
+    global _OP_OBSERVER
+    prev = _OP_OBSERVER
+    _OP_OBSERVER = obs
+    return prev
+
+
+def _notify_host_read():
+    if _OP_OBSERVER is not None:
+        _OP_OBSERVER.on_host_read()
+
+
 def apply_op(raw_fn, *args, **kwargs):
     """Execute a raw jax-level op on Tensor/array args.
 
@@ -405,8 +429,18 @@ def apply_op(raw_fn, *args, **kwargs):
 
     opname = getattr(raw_fn, "__name__", "op")
     if not diff_idx:
+        obs = _OP_OBSERVER
+        if obs is not None:
+            sub = obs.on_op(raw_fn, template, kwargs, arrays)
+            if sub is not OBS_MISS:
+                return _wrap_out(sub, node=None, opname=opname)
         out = raw_fn(*rebuild(arrays), **kwargs)
+        if obs is not None:
+            obs.on_result(raw_fn, template, kwargs, arrays, out)
         return _wrap_out(out, node=None, opname=opname)
+    if _OP_OBSERVER is not None:
+        # grad-path ops are not captured — close the recorded prefix
+        _OP_OBSERVER.on_host_read()
 
     def f(*diff_arrays):
         full = list(arrays)

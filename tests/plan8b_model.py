@@ -1,0 +1,23 @@
+"""Shared activation-model coefficients for the 8B plan.
+
+Calibrated round 4 against the REAL chip: tests/plan8b_tpu_check.py
+compiles the true-width step at 1 and 2 layers and reads XLA's
+``compiled.memory_analysis()`` — per-layer temp 0.341 GB (≈ 5.1
+[B,S,H]-bf16 residual equivalents under core_attn remat + flash
+out/lse + XLA scheduling slack; the round-3 hand formula said 4) and a
+2.95 GB layer-independent base (CE-chunk workspace + embed/head grad
+transients the hand formula undercounted; single-chip value — the
+conservative bound, sharded-grad meshes shrink the embed/head term).
+
+Single source of truth: plan8b_worker.py builds the plans from these,
+and test_8b_plan.py asserts they stay within 15% of the compiler.
+"""
+SEQ, VOCAB, HIDDEN, FFN = 8192, 128256, 4096, 14336
+LAYERS_TRUE = 32
+ACT_RESID_PER_LAYER = 5.1      # measured r4 (hand formula said 4)
+ACT_BASE = 2.95e9              # measured r4
+
+
+def act_bytes(layers=LAYERS_TRUE, micro=1, seq=SEQ, hidden=HIDDEN):
+    return (ACT_RESID_PER_LAYER * micro * seq * hidden * 2 * layers
+            + ACT_BASE)

@@ -1,8 +1,15 @@
-"""Llama-3-8B @ v5p-64 shard/memory plan proof (VERDICT r2 missing #7).
+"""Llama-3-8B @ v5p-64 shard/memory plan proof (VERDICT r2 missing #7,
+r3 Missing #5).
 
-Runs tests/plan8b_worker.py in a subprocess with 64 virtual CPU devices:
-TRUE 8B dimensions, real 64-device mesh, real ShardingPlan specs, and
-analytic per-chip accounting asserted against the v5p's 95 GB HBM.
+1. tests/plan8b_worker.py (subprocess, 64 virtual CPU devices): TRUE 8B
+   dimensions, real 64-device meshes, real ShardingPlan specs, per-chip
+   accounting asserted against the v5p's 95 GB HBM — for BOTH the ZeRO
+   plan (dp=8 x sharding=8, stage 3) and the ERNIE-class TP+PP plan
+   (pp=4 x mp=4 x sharding=4, fused-1F1B n_micro=8).
+2. tests/plan8b_tpu_check.py (subprocess, REAL chip when reachable):
+   compiles the true-width step at 1 and 2 layers with the real Mosaic
+   flash kernel and asserts the worker's calibrated analytic activation
+   model stays within 15% of XLA's own memory_analysis extrapolation.
 """
 import json
 import os
@@ -12,25 +19,71 @@ import sys
 import pytest
 
 
+def _run_worker(name, timeout, pythonpath=True):
+    env = dict(os.environ)
+    if pythonpath:
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    else:
+        # setting PYTHONPATH breaks the axon sitecustomize's TPU
+        # backend registration; the tpu-check worker sys.path-inserts
+        env.pop("PYTHONPATH", None)
+        env["JAX_PLATFORMS"] = "axon"    # conftest pinned cpu for CI
+    env.pop("XLA_FLAGS", None)      # workers set their own flags
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          name)
+    return subprocess.run([sys.executable, worker], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
 @pytest.mark.timeout(900)
 def test_8b_plan_fits_v5p_64():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))
-    env.pop("XLA_FLAGS", None)      # worker sets its own 64-device flag
-    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "plan8b_worker.py")
-    proc = subprocess.run([sys.executable, worker], env=env,
-                          capture_output=True, text=True, timeout=850)
+    proc = _run_worker("plan8b_worker.py", 850)
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
     res = json.loads(line)
     # the true 8B parameter count (8.03B), not a scaled stand-in
     assert abs(res["params_total_8b"] - 8.03e9) < 0.05e9
-    assert res["mesh"] == {"pp": 1, "dp": 8, "sharding": 8, "ep": 1,
-                           "sep": 1, "mp": 1}
-    assert res["fits"]
-    assert res["total_gb_per_chip"] <= 95.0
+
+    a = res["plan_a"]
+    assert a["mesh"] == {"pp": 1, "dp": 8, "sharding": 8, "ep": 1,
+                         "sep": 1, "mp": 1}
+    assert a["fits"] and a["total_gb_per_chip"] <= 95.0
     # ZeRO-3 really sharded the big weights (not replicated)
-    assert "sharding" in res["embedding_spec"]
-    assert "sharding" in res["qproj_spec"]
+    assert "sharding" in a["embedding_spec"]
+    assert "sharding" in a["qproj_spec"]
+
+    b = res["plan_b"]
+    assert b["mesh"]["pp"] == 4 and b["mesh"]["mp"] == 4 \
+        and b["mesh"]["sharding"] == 4
+    assert b["fits"] and b["total_gb_per_chip"] <= 95.0
+    # pipe stacks sharded over pp AND tensor-parallel over mp
+    assert "pp" in b["qw_spec"] and "mp" in b["qw_spec"]
+
+
+@pytest.mark.timeout(1500)
+def test_8b_activation_model_matches_tpu_compiler():
+    """Real-chip cross-check of the analytic activation coefficients.
+
+    Skips when no TPU is reachable: the axon tunnel grants ONE python
+    process the chip, and a pytest parent already holds the claim —
+    run ``python tests/plan8b_tpu_check.py`` standalone to exercise it
+    (done in round 4; the measured coefficients live in
+    plan8b_model.py and BASELINE.md)."""
+    proc = _run_worker("plan8b_tpu_check.py", 1400, pythonpath=False)
+    if proc.returncode == 86:
+        pytest.skip("no TPU backend reachable")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    if "skip" in res:
+        pytest.skip(res["skip"])
+    measured = res["extrapolated_32layer_gb"]
+
+    # the worker's calibrated model at the same shape (micro 1, 32L) —
+    # single source of truth in plan8b_model.py
+    from plan8b_model import act_bytes
+    analytic = act_bytes() / 1e9
+    assert abs(measured - analytic) / measured <= 0.15, (measured,
+                                                         analytic)
