@@ -68,6 +68,10 @@ class Model:
         # step — silently resetting it was ADVICE r3 (e.g. a metrics
         # tweak mid-training zeroing Adam moments)
         if self._train_step is not None:
+            # trained params live in the step's donated state — push
+            # them back into the Layer FIRST, else the rebuilt step
+            # restarts from stale weights (with warm moments, worse)
+            self._train_step.sync_to_model()
             if optimizer is not None and optimizer is getattr(
                     self._train_step, "optimizer", None):
                 self._pending_opt_state = self._train_step.state.get(

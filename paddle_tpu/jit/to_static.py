@@ -26,13 +26,15 @@ The returned StaticFunction:
     contract and re-raises.
   * **compiled-prefix capture** (round 4, SOT's compiled-segment
     behavior): the breaking call records the pre-break op stream while
-    running eagerly; subsequent same-signature no-grad calls execute
-    the prefix as ONE jitted XLA program and substitute its results
+    running eagerly; subsequent same-signature calls execute the
+    prefix as ONE jitted XLA program and substitute its results
     op-by-op under guards (jit/prefix.py), so only the post-break tail
     pays eager dispatch.  Stats: ``prefix_op_count``,
-    ``prefix_replay_count``, ``last_replayed_ops``.  Under grad mode
-    the whole signature stays plainly eager (the tape needs per-op
-    vjps).  On the one breaking call, python side effects before the
+    ``prefix_replay_count``, ``last_replayed_ops``.  Only NON-diff
+    ops are captured — under grad mode the prefix closes at the first
+    grad-path op (the tape needs its per-op vjps) and the prefix
+    cache keys on grad mode + arg stop-gradient flags.  On the one
+    breaking call, python side effects before the
     break run twice (the aborted trace + the recording run);
     tensor/layer state is unaffected (functional_state and rng_guard
     unwind the aborted trace).

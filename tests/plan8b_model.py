@@ -21,3 +21,21 @@ ACT_BASE = 2.95e9              # measured r4
 def act_bytes(layers=LAYERS_TRUE, micro=1, seq=SEQ, hidden=HIDDEN):
     return (ACT_RESID_PER_LAYER * micro * seq * hidden * 2 * layers
             + ACT_BASE)
+
+
+def zero_init_params():
+    """Accounting/compile-only workers: parameter VALUES are
+    irrelevant, so zero-init everything (random normal over 1.2B
+    params costs minutes on this 1-core host)."""
+    from paddle_tpu.nn import initializer as _ini
+
+    def _zeros(self, shape, dtype):
+        import jax.numpy as _jnp
+
+        from paddle_tpu.common.dtype import convert_dtype as _cd
+        return _jnp.zeros([int(s) for s in shape], _cd(dtype))
+
+    for _cls in (_ini.Normal, _ini.TruncatedNormal, _ini.Uniform,
+                 _ini.XavierNormal, _ini.XavierUniform,
+                 _ini.KaimingNormal, _ini.KaimingUniform):
+        _cls.__call__ = _zeros

@@ -33,23 +33,13 @@ except Exception as e:  # noqa: BLE001
 
 import paddle_tpu as paddle  # noqa: E402
 
-# accounting/compile-only workers: parameter VALUES are irrelevant, so
-# zero-init everything (random normal over 1.2B params costs minutes on
-# this 1-core host)
-from paddle_tpu.nn import initializer as _ini  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from plan8b_model import FFN, HIDDEN, SEQ, VOCAB  # noqa: E402
+from plan8b_model import zero_init_params  # noqa: E402
 
-def _zeros(self, shape, dtype):
-    import jax.numpy as _jnp
-    from paddle_tpu.common.dtype import convert_dtype as _cd
-    return _jnp.zeros([int(s) for s in shape], _cd(dtype))
-
-for _cls in (_ini.Normal, _ini.TruncatedNormal, _ini.Uniform,
-             _ini.XavierNormal, _ini.XavierUniform,
-             _ini.KaimingNormal, _ini.KaimingUniform):
-    _cls.__call__ = _zeros
+zero_init_params()
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM  # noqa
 
-SEQ, VOCAB, HIDDEN, FFN = 8192, 128256, 4096, 14336
 CPU = jax.local_devices(backend="cpu")[0]
 
 

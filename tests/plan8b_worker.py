@@ -42,21 +42,9 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 import paddle_tpu as paddle  # noqa: E402
+from plan8b_model import zero_init_params  # noqa: E402
 
-# accounting/compile-only workers: parameter VALUES are irrelevant, so
-# zero-init everything (random normal over 1.2B params costs minutes on
-# this 1-core host)
-from paddle_tpu.nn import initializer as _ini  # noqa: E402
-
-def _zeros(self, shape, dtype):
-    import jax.numpy as _jnp
-    from paddle_tpu.common.dtype import convert_dtype as _cd
-    return _jnp.zeros([int(s) for s in shape], _cd(dtype))
-
-for _cls in (_ini.Normal, _ini.TruncatedNormal, _ini.Uniform,
-             _ini.XavierNormal, _ini.XavierUniform,
-             _ini.KaimingNormal, _ini.KaimingUniform):
-    _cls.__call__ = _zeros
+zero_init_params()
 from paddle_tpu.distributed import fleet  # noqa: E402
 from paddle_tpu.distributed.sharding import ShardingPlan  # noqa: E402
 from paddle_tpu.models.llama import (LlamaConfig,  # noqa: E402
