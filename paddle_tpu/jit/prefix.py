@@ -24,8 +24,11 @@ TPU-native design — memoized compiled prefix with guarded replay:
   execution from that op on (results stay correct because substituted
   values are real arrays).
 * Python between/after ops still executes (side effects preserved);
-  everything AFTER the break runs eagerly, exactly as before.  Grad
-  mode disables capture entirely (the eager tape needs per-op vjps).
+  everything AFTER the break runs eagerly, exactly as before.  Only
+  NON-diff ops are captured: a grad-path op closes the prefix (the
+  eager tape needs its per-op vjps), and the prefix cache keys on
+  grad mode + arg stop-gradient flags so diff-ness cannot differ
+  between recording and replay.
 """
 from __future__ import annotations
 

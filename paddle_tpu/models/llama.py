@@ -565,13 +565,14 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     # training default: fused 1F1B schedule — interleaved when
     # n_virtual > 1 (activation memory ∝ pp in-flight microbatches,
     # not n_micro); custom_vjp, so this is also the eval path (plain
-    # fwd pipeline) when not under grad.  Residual stashing requires
-    # v == 1 (weight-identity filtering needs static chunk tracers).
+    # fwd pipeline) when not under grad.  Residual stashing composes
+    # with interleaving (per-lap switch branches keep chunk tracers
+    # static for the weight-identity filter).
     from ..distributed.pipeline import pipeline_train_1f1b
     return pipeline_train_1f1b(
         stage_fn, tail_fn, pm.mesh, pp_axis, tuple(stacked), xm,
-        (cos, sin), (norm_w, head_w), (lm,),
-        stash_residuals and n_virtual == 1, n_virtual)
+        (cos, sin), (norm_w, head_w), (lm,), stash_residuals,
+        n_virtual)
 
 
 def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,

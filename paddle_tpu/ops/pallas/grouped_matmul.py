@@ -52,7 +52,9 @@ def _pick_tile(dim: int, cap: int) -> int:
         t -= 128
     else:
         return dim
-    if t < 512 and dim <= 2048:
+    # the full-dim override stays VMEM-bounded: past ~1.5k lanes a
+    # full-dim block on BOTH operands can blow the 16M scoped budget
+    if t < 512 and dim <= 1536:
         return dim
     return t
 
@@ -278,7 +280,7 @@ def _auto_tm(e: int, n_rows: int) -> int:
     tm = 512 if e <= 16 else 384
     while tm > 128 and e * tm > n_rows:
         tm //= 2
-    return tm
+    return max(tm, 128)
 
 
 def dropless_moe_ffn_rows(x_rows, row_expert, wg, wu, wd, *, tm=None,

@@ -344,12 +344,18 @@ def test_1f1b_loss_and_grads_match_serial(stash):
                                    atol=1e-6, rtol=1e-4)
 
 
-@pytest.mark.parametrize("s,v,nm", [(2, 2, 4), (4, 2, 8), (2, 3, 6)])
-def test_interleaved_1f1b_loss_and_grads_match_serial(s, v, nm):
+@pytest.mark.parametrize("s,v,nm,stash", [(2, 2, 4, False),
+                                          (4, 2, 8, False),
+                                          (2, 3, 6, False),
+                                          (2, 2, 4, True),
+                                          (4, 2, 8, True),
+                                          (2, 3, 6, True)])
+def test_interleaved_1f1b_loss_and_grads_match_serial(s, v, nm, stash):
     """Fused INTERLEAVED 1F1B (n_virtual>1): loss and every gradient
     equal the serial model — the mirror-schedule tick algebra routes
     each chunk's activations/cotangents and lap-scattered weight grads
-    correctly."""
+    correctly, in both the recompute and residual-stash (per-lap
+    switch-branch capture) backward modes."""
     from paddle_tpu.distributed.pipeline import pipeline_train_1f1b
     import jax.numpy as jnp
 
@@ -359,7 +365,7 @@ def test_interleaved_1f1b_loss_and_grads_match_serial(s, v, nm):
 
     def loss_pipe(ws, vw, xm):
         return pipeline_train_1f1b(stage_fn, tail_fn, mesh, "pp",
-                                   (ws,), xm, (), (vw,), (lm,), False,
+                                   (ws,), xm, (), (vw,), (lm,), stash,
                                    v)
 
     def loss_serial(ws, vw, xm):
@@ -380,10 +386,11 @@ def test_interleaved_1f1b_loss_and_grads_match_serial(s, v, nm):
                                    atol=1e-6, rtol=1e-4)
 
 
-def test_interleaved_1f1b_memory_independent_of_n_micro():
+@pytest.mark.parametrize("stash", [False, True])
+def test_interleaved_1f1b_memory_independent_of_n_micro(stash):
     """v=2 interleaved fused engine: compiled peak temp memory flat in
     n_micro (2vS chunk-slot rings, ∝ pp — not the AD-through-loop
-    ∝ n_micro residual growth)."""
+    ∝ n_micro residual growth) — in both backward modes."""
     from paddle_tpu.distributed.pipeline import pipeline_train_1f1b
     import jax.numpy as jnp
 
@@ -396,7 +403,7 @@ def test_interleaved_1f1b_memory_independent_of_n_micro():
         def loss(ws, vw):
             return pipeline_train_1f1b(stage_fn, tail_fn, mesh, "pp",
                                        (ws,), xm, (), (vw,), (lm,),
-                                       False, v)
+                                       stash, v)
         g = jax.jit(jax.grad(loss, argnums=(0, 1)))
         c = g.lower(ws, vw).compile()
         return c.memory_analysis().temp_size_in_bytes
