@@ -108,15 +108,19 @@ class Conv2DTranspose(_ConvNd):
 
 
 class MaxPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 return_mask=False, data_format="NCHW", name=None):
+    # paddle argument order: return_mask BEFORE ceil_mode
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCHW",
+                 name=None):
         super().__init__()
         self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
         self.ceil_mode = ceil_mode
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode)
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool2D(Layer):

@@ -433,16 +433,43 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCHW"):
+def max_pool2d(x, kernel_size, stride=None, padding=0,
+               return_mask=False, ceil_mode=False, data_format="NCHW"):
+    # paddle argument ORDER kept exactly (return_mask BEFORE ceil_mode)
+    # — positional paddle code like max_pool2d(x, 2, 2, 0, True) must
+    # mean return_mask=True here too
     n = 2
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
     p = _conv_padding(padding, n, s, (1, 1), k)
-    if isinstance(p, str):
-        pads = p
-    else:
-        pads = [(0, 0), (0, 0)] + list(p)
+    if return_mask:
+        # mask = flat argmax position within each (N, C) plane (the
+        # max_unpool2d contract).  Non-overlapping unpadded windows —
+        # the SegNet pool/unpool pairing — are exact via the window
+        # reshape; other geometries (overlap, any padding incl.
+        # "SAME") are not supported.
+        if (s != k or isinstance(p, str)
+                or any(a or b for a, b in p)):
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True) supports stride == "
+                "kernel_size with no padding")
+        nb, c, h, w = x.shape
+        oh, ow = h // k[0], w // k[1]
+        win = x[:, :, :oh * k[0], :ow * k[1]].reshape(
+            nb, c, oh, k[0], ow, k[1])
+        win = jnp.moveaxis(win, 3, 4).reshape(nb, c, oh, ow,
+                                              k[0] * k[1])
+        # out derived from the SAME window tensor: out/mask shape
+        # agreement holds by construction, no second reduction
+        out = jnp.max(win, axis=-1)
+        flat_in_win = jnp.argmax(win, axis=-1)
+        wr = flat_in_win // k[1]
+        wc = flat_in_win % k[1]
+        rows = jnp.arange(oh)[None, None, :, None] * k[0] + wr
+        cols = jnp.arange(ow)[None, None, None, :] * k[1] + wc
+        mask = (rows * w + cols).astype(jnp.int32)
+        return out, mask
+    pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
     dims = (1, 1) + k
     strides = (1, 1) + s
     out = lax.reduce_window(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
@@ -453,6 +480,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True) is not supported; the 2D "
+            "pool/unpool pairing is (max_pool2d, max_unpool2d)")
     n = 3
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)

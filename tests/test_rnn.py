@@ -216,6 +216,34 @@ def test_ctc_loss_matches_torch():
                                tl.numpy(), rtol=1e-4, atol=1e-4)
 
 
+def test_max_pool_unpool_roundtrip():
+    """max_pool2d(return_mask=True) -> max_unpool2d restores the max
+    values at their argmax positions (the SegNet pairing)."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    assert tuple(mask.shape) == (2, 3, 4, 4)
+    # mask points at the true argmax inside each window
+    up = F.max_unpool2d(out, mask, 2)
+    up_np = np.asarray(up.numpy())
+    want = np.zeros_like(x)
+    for n in range(2):
+        for c in range(3):
+            for i in range(4):
+                for j in range(4):
+                    win = x[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    r, s = np.unravel_index(win.argmax(), (2, 2))
+                    want[n, c, 2 * i + r, 2 * j + s] = win.max()
+    np.testing.assert_allclose(up_np, want, atol=1e-6)
+    # layer form
+    pool = nn.MaxPool2D(2, return_mask=True)
+    o2, m2 = pool(paddle.to_tensor(x))
+    np.testing.assert_array_equal(np.asarray(m2.numpy()),
+                                  np.asarray(mask.numpy()))
+
+
 def test_spectral_norm_power_iteration():
     rng = np.random.default_rng(6)
     w = rng.standard_normal((6, 4)).astype(np.float32)
