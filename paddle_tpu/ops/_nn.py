@@ -1201,6 +1201,11 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     k = _norm_tuple(kernel_size, 1)[0]
     s = _norm_tuple(stride if stride is not None else kernel_size, 1)[0]
     p = _norm_tuple(padding, 1)[0]
+    if return_mask:
+        out, mask = max_pool2d(x4, (1, k), (1, s), (0, p),
+                               return_mask=True)
+        # plane width == L, single row: the 2D flat index IS the 1D one
+        return out[:, :, 0, :], mask[:, :, 0, :]
     return max_pool2d(x4, (1, k), (1, s), (0, p))[:, :, 0, :]
 
 

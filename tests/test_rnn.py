@@ -242,6 +242,13 @@ def test_max_pool_unpool_roundtrip():
     o2, m2 = pool(paddle.to_tensor(x))
     np.testing.assert_array_equal(np.asarray(m2.numpy()),
                                   np.asarray(mask.numpy()))
+    # 1D variant: flat indices within [L]
+    x1 = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    o1d, m1d = F.max_pool1d(paddle.to_tensor(x1), 2, return_mask=True)
+    assert tuple(o1d.shape) == (2, 3, 4) and tuple(m1d.shape) == (2, 3, 4)
+    want_idx = x1.reshape(2, 3, 4, 2).argmax(-1) + \
+        np.arange(4)[None, None, :] * 2
+    np.testing.assert_array_equal(np.asarray(m1d.numpy()), want_idx)
 
 
 def test_spectral_norm_power_iteration():
