@@ -137,19 +137,20 @@ class AvgPool2D(Layer):
 
 
 class MaxPool1D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 return_mask=False, name=None):
+    # paddle argument order: return_mask BEFORE ceil_mode
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride if stride is not None else kernel_size
         self.padding = padding
+        self.return_mask = return_mask
+        self.ceil_mode = ceil_mode
 
     def forward(self, x):
-        from .. import ops
-        x4 = ops.unsqueeze(x, 2)
-        out = F.max_pool2d(x4, (1, self.kernel_size), (1, self.stride),
-                           (0, self.padding))
-        return ops.squeeze(out, 2)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
+                            ceil_mode=self.ceil_mode)
 
 
 class AvgPool1D(Layer):

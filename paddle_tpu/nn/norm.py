@@ -203,19 +203,11 @@ class LocalResponseNorm(Layer):
         self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
 
     def forward(self, x):
-        from .. import ops
-        import jax.numpy as jnp
+        from ..ops._nn import local_response_norm
         from ..tensor import apply_op
-
-        def _lrn(x):
-            sq = jnp.square(x)
-            half = self.size // 2
-            pad = [(0, 0), (half, self.size - 1 - half)] + \
-                [(0, 0)] * (x.ndim - 2)
-            padded = jnp.pad(sq, pad)
-            acc = sum(padded[:, i:i + x.shape[1]] for i in range(self.size))
-            return x / jnp.power(self.k + self.alpha * acc, self.beta)
-        return apply_op(_lrn, x)
+        return apply_op(
+            lambda a: local_response_norm(
+                a, self.size, self.alpha, self.beta, self.k), x)
 
 
 class SpectralNorm(Layer):

@@ -433,6 +433,24 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def _ceil_mode_pads(spatial, k, s, p):
+    """Extend the high-side pads so reduce_window emits ceil-divided
+    output sizes.  The extra window must start inside input + left pad
+    (torch/paddle rule); max pools pad with -inf so the extension never
+    changes window maxima."""
+    out = []
+    for d, dim in enumerate(spatial):
+        lo, hi = p[d]
+        eff = dim + lo + hi
+        n_floor = (eff - k[d]) // s[d] + 1
+        n_ceil = -(-(eff - k[d]) // s[d]) + 1
+        if n_ceil > n_floor and (n_ceil - 1) * s[d] >= dim + lo:
+            n_ceil -= 1
+        extra = (n_ceil - 1) * s[d] + k[d] - eff
+        out.append((lo, hi + max(extra, 0)))
+    return out
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0,
                return_mask=False, ceil_mode=False, data_format="NCHW"):
     # paddle argument ORDER kept exactly (return_mask BEFORE ceil_mode)
@@ -442,13 +460,19 @@ def max_pool2d(x, kernel_size, stride=None, padding=0,
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
     p = _conv_padding(padding, n, s, (1, 1), k)
+    if ceil_mode and not isinstance(p, str):
+        p = _ceil_mode_pads(x.shape[2:2 + n], k, s, p)
     if return_mask:
+        if ceil_mode and (x.shape[2] % k[0] or x.shape[3] % k[1]):
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True, ceil_mode=True) with a "
+                "partial trailing window is not supported")
         # mask = flat argmax position within each (N, C) plane (the
         # max_unpool2d contract).  Non-overlapping unpadded windows —
         # the SegNet pool/unpool pairing — are exact via the window
         # reshape; other geometries (overlap, any padding incl.
         # "SAME") are not supported.
-        if (s != k or isinstance(p, str)
+        if (list(s) != list(k) or isinstance(p, str)
                 or any(a or b for a, b in p)):
             raise NotImplementedError(
                 "max_pool2d(return_mask=True) supports stride == "
@@ -488,6 +512,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
     p = _conv_padding(padding, n, s, (1, 1, 1), k)
+    if ceil_mode and not isinstance(p, str):
+        p = _ceil_mode_pads(x.shape[2:2 + n], k, s, p)
     pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
     out = lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
@@ -1162,11 +1188,13 @@ def zeropad2d(x, padding, data_format="NCHW"):
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW"):
+    # paddle implements square -> pad -> AVG_pool -> scale, so the alpha
+    # term is alpha * sum(x^2) / size, not alpha * sum(x^2)
     sq = jnp.square(x)
     half = size // 2
     pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
     padded = jnp.pad(sq, pad)
-    acc = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(size)) / size
     return x / jnp.power(k + alpha * acc, beta)
 
 
@@ -1203,10 +1231,11 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     p = _norm_tuple(padding, 1)[0]
     if return_mask:
         out, mask = max_pool2d(x4, (1, k), (1, s), (0, p),
-                               return_mask=True)
+                               return_mask=True, ceil_mode=ceil_mode)
         # plane width == L, single row: the 2D flat index IS the 1D one
         return out[:, :, 0, :], mask[:, :, 0, :]
-    return max_pool2d(x4, (1, k), (1, s), (0, p))[:, :, 0, :]
+    return max_pool2d(x4, (1, k), (1, s), (0, p),
+                      ceil_mode=ceil_mode)[:, :, 0, :]
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -1225,6 +1254,17 @@ def adaptive_avg_pool1d(x, output_size):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False):
+    if return_mask:
+        o = _norm_tuple(output_size, 1)[0]
+        length = x.shape[-1]
+        outs, idxs = [], []
+        for i in range(o):
+            l0, l1 = (i * length) // o, -(-((i + 1) * length) // o)
+            seg = x[:, :, l0:l1]
+            outs.append(jnp.max(seg, axis=-1))
+            idxs.append(jnp.argmax(seg, axis=-1) + l0)
+        return (jnp.stack(outs, -1),
+                jnp.stack(idxs, -1).astype(jnp.int32))
     x4 = x[:, :, None, :]
     return adaptive_max_pool2d(x4, (1, output_size))[:, :, 0, :]
 
@@ -1249,6 +1289,10 @@ def adaptive_avg_pool3d(x, output_size):
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not supported "
+            "(same stance as max_pool3d)")
     return _adaptive_pool3d(x, output_size, jnp.max)
 
 

@@ -266,3 +266,96 @@ class TestDataLoaderWorkers:
                         worker_init_fn=init))
         import os
         assert os.path.exists(marker + "0")
+
+
+class TestAdviceR4Fixes:
+    """Value-oracle tests for the round-4 advisor findings."""
+
+    def test_local_response_norm_torch_oracle(self):
+        import torch
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 6, 5, 4)).astype(np.float32)
+        for size in (2, 3, 5):
+            want = torch.nn.functional.local_response_norm(
+                torch.from_numpy(x), size, alpha=1e-2, beta=0.75,
+                k=1.0).numpy()
+            got = _np(paddle.nn.functional.local_response_norm(
+                _t(x), size, alpha=1e-2, beta=0.75, k=1.0))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+            layer = paddle.nn.LocalResponseNorm(size, alpha=1e-2)
+            np.testing.assert_allclose(_np(layer(_t(x))), want,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_erfcx_large_x_finite(self):
+        from scipy import special as sp
+        x = np.array([-1.0, 0.0, 1.0, 5.0, 7.9, 8.1, 12.0, 30.0, 100.0],
+                     np.float32)
+        got = _np(P.erfcx(_t(x)))
+        want = sp.erfcx(x.astype(np.float64))
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    def test_adaptive_max_pool1d_return_mask(self):
+        import torch
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 3, 11)).astype(np.float32)
+        out, mask = paddle.nn.functional.adaptive_max_pool1d(
+            _t(x), 4, return_mask=True)
+        tout, tidx = torch.nn.functional.adaptive_max_pool1d(
+            torch.from_numpy(x), 4, return_indices=True)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(_np(mask), tidx.numpy())
+
+    def test_adaptive_max_pool3d_return_mask_raises(self):
+        x = _t(np.zeros((1, 1, 4, 4, 4), np.float32))
+        with pytest.raises(NotImplementedError):
+            paddle.nn.functional.adaptive_max_pool3d(x, 2, return_mask=True)
+
+    def test_maxpool1d_layer_positional_return_mask(self):
+        # paddle order: kernel_size, stride, padding, return_mask, ceil_mode
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 3, 8)).astype(np.float32)
+        layer = paddle.nn.MaxPool1D(2, 2, 0, True)
+        out, mask = layer(_t(x))
+        want = x.reshape(2, 3, 4, 2).max(-1)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-6)
+        want_idx = x.reshape(2, 3, 4, 2).argmax(-1) + \
+            np.arange(4)[None, None, :] * 2
+        np.testing.assert_array_equal(_np(mask), want_idx)
+
+    def test_max_pool_ceil_mode_torch_oracle(self):
+        import torch
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(2, 3, 7, 9)).astype(np.float32)
+        for k, s, p in ((2, 2, 0), (3, 2, 1), (2, 3, 0)):
+            want = torch.nn.functional.max_pool2d(
+                torch.from_numpy(x), k, s, p, ceil_mode=True).numpy()
+            got = _np(paddle.nn.functional.max_pool2d(
+                _t(x), k, s, p, ceil_mode=True))
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+        x1 = rng.normal(size=(2, 3, 5)).astype(np.float32)
+        want = torch.nn.functional.max_pool1d(
+            torch.from_numpy(x1), 2, 2, 0, ceil_mode=True).numpy()
+        got = _np(paddle.nn.functional.max_pool1d(
+            _t(x1), 2, 2, 0, ceil_mode=True))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        # the layer path too (paddle order: ..., return_mask, ceil_mode)
+        layer = paddle.nn.MaxPool1D(2, 2, 0, False, True)
+        np.testing.assert_allclose(_np(layer(_t(x1))), want, rtol=1e-6)
+        x3 = rng.normal(size=(1, 2, 5, 5, 5)).astype(np.float32)
+        want = torch.nn.functional.max_pool3d(
+            torch.from_numpy(x3), 2, 2, 0, ceil_mode=True).numpy()
+        got = _np(paddle.nn.functional.max_pool3d(
+            _t(x3), 2, 2, 0, ceil_mode=True))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_erfcx_float64(self):
+        from scipy import special as sp
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        try:
+            x = np.array([1.0, 10.0, 25.0, 27.0, 100.0], np.float64)
+            got = np.asarray(P.erfcx(_t(x)))
+            np.testing.assert_allclose(got, sp.erfcx(x), rtol=1e-10)
+        finally:
+            jax.config.update("jax_enable_x64", False)
