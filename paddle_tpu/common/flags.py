@@ -102,3 +102,7 @@ define_flag("use_pallas", True, "use Pallas kernels where available (TPU)")
 define_flag("eager_jit_ops", False, "jit each eager op call (per-op cache)")
 define_flag("log_level", 0, "VLOG-style verbosity; higher = chattier")
 define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA owns memory")
+define_flag("moe_log_drops", False,
+            "print exact dropped-row counts from the capacity-bounded "
+            "expert-parallel MoE exchange (jax.debug.print, works "
+            "under jit)")

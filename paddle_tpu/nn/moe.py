@@ -221,6 +221,14 @@ class MoELayer(Layer):
     forward(x [B,S,H]) -> [B,S,H]; the router's aux loss for the step is
     exposed as ``self.aux_loss`` (models sum it into the train loss, the
     reference's pattern).
+
+    ``ep_capacity_factor`` bounds the grouped_ep path's TOTAL per-shard
+    receive buffer at factor × the balanced load (``None`` = strictly
+    dropless at any router skew); the ragged exchange itself always
+    moves exactly the routed rows.  Set ``FLAGS_moe_log_drops=1`` to
+    print the exact dropped-row count per call (device-side
+    ``jax.debug.print``, works under jit) — the observable twin of the
+    reference's capacity/overflow logging.
     """
 
     def __init__(self, hidden_size: int, num_experts: int,
@@ -326,9 +334,11 @@ class MoELayer(Layer):
         flat = apply_op(lambda a: a.reshape(b * s, h), x)
         mode = self._resolve_dispatch(b * s)
         if mode == "grouped_ep":
+            from ..common.flags import get_flags
             from ..distributed.auto_parallel import get_mesh
             from ..distributed.expert_parallel import moe_grouped_ep_raw
-            out, aux = apply_op(
+            log_drops = bool(get_flags("moe_log_drops")["moe_log_drops"])
+            out, aux, dropped = apply_op(
                 moe_grouped_ep_raw, flat, self.gate.weight,
                 self.experts.gate_w, self.experts.up_w,
                 self.experts.down_w, k=self.gate.k,
@@ -337,7 +347,14 @@ class MoELayer(Layer):
                 interpret=jax.default_backend() != "tpu",
                 norm_topk=self.gate.norm_topk_prob,
                 mesh=get_mesh().mesh,
-                capacity_factor=self.ep_capacity_factor)
+                capacity_factor=self.ep_capacity_factor,
+                return_drops=True)
+            if log_drops:
+                jax.debug.print(
+                    "moe_grouped_ep dropped {d} / {t} routed rows "
+                    "(ep_capacity_factor={f})",
+                    d=getattr(dropped, "value", dropped),
+                    t=b * s * self.gate.k, f=self.ep_capacity_factor)
         elif mode == "grouped":
             out, aux = apply_op(
                 _moe_grouped_raw, flat, self.gate.weight,

@@ -433,6 +433,28 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def _channel_last_aware(fn):
+    """Pool-family decorator: a channel-last ``data_format`` kwarg
+    ("NHWC"/"NDHWC") transposes to channel-first, runs the NC*-native
+    body, and transposes every output back (mask values are plane-flat
+    spatial indices, layout-independent)."""
+    import functools as _ft
+
+    @_ft.wraps(fn)
+    def wrapped(x, *args, **kwargs):
+        df = kwargs.get("data_format")
+        if df and len(df) > 2 and df.endswith("C"):
+            perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+            inv = (0,) + tuple(range(2, x.ndim)) + (1,)
+            kwargs["data_format"] = df[0] + "C" + df[1:-1]
+            out = fn(jnp.transpose(x, perm), *args, **kwargs)
+            if isinstance(out, tuple):
+                return tuple(jnp.transpose(o, inv) for o in out)
+            return jnp.transpose(out, inv)
+        return fn(x, *args, **kwargs)
+    return wrapped
+
+
 def _ceil_mode_pads(spatial, k, s, p):
     """Extend the high-side pads so reduce_window emits ceil-divided
     output sizes.  The extra window must start inside input + left pad
@@ -451,6 +473,7 @@ def _ceil_mode_pads(spatial, k, s, p):
     return out
 
 
+@_channel_last_aware
 def max_pool2d(x, kernel_size, stride=None, padding=0,
                return_mask=False, ceil_mode=False, data_format="NCHW"):
     # paddle argument ORDER kept exactly (return_mask BEFORE ceil_mode)
@@ -502,6 +525,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0,
     return out
 
 
+@_channel_last_aware
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
     if return_mask:
@@ -522,6 +546,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return out
 
 
+@_channel_last_aware
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None,
                data_format="NCDHW"):
@@ -541,6 +566,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return summed / float(np.prod(k))
 
 
+@_channel_last_aware
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
     n = 2
@@ -577,6 +603,7 @@ def _adaptive_pool2d(x, output_size, reduce_fn):
     return jnp.stack(rows, -2)
 
 
+@_channel_last_aware
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     out = _norm_tuple(output_size, 2)
     h, w = x.shape[2], x.shape[3]
@@ -586,6 +613,7 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
     return _adaptive_pool2d(x, out, lambda s: jnp.mean(s, axis=(2, 3)))
 
 
+@_channel_last_aware
 def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
     out = _norm_tuple(output_size, 2)
     h, w = x.shape[2], x.shape[3]

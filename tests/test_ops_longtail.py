@@ -359,3 +359,35 @@ class TestAdviceR4Fixes:
             np.testing.assert_allclose(got, sp.erfcx(x), rtol=1e-10)
         finally:
             jax.config.update("jax_enable_x64", False)
+
+    def test_pool_nhwc_data_format(self):
+        import torch
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(2, 3, 7, 9)).astype(np.float32)  # NCHW
+        x_nhwc = np.transpose(x, (0, 2, 3, 1))
+        want = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 3, 2, 1, ceil_mode=True).numpy()
+        got = _np(paddle.nn.functional.max_pool2d(
+            _t(x_nhwc), 3, 2, 1, ceil_mode=True, data_format="NHWC"))
+        np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)),
+                                   want, rtol=1e-6)
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 2, 2, 0).numpy()
+        got = _np(paddle.nn.functional.avg_pool2d(
+            _t(x_nhwc), 2, 2, 0, data_format="NHWC"))
+        np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)),
+                                   want, rtol=1e-6)
+        got = _np(paddle.nn.functional.adaptive_avg_pool2d(
+            _t(x_nhwc), [3, 2], data_format="NHWC"))
+        want = torch.nn.functional.adaptive_avg_pool2d(
+            torch.from_numpy(x), (3, 2)).numpy()
+        np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)),
+                                   want, rtol=1e-6)
+
+    def test_erfcx_float16_finite(self):
+        from scipy import special as sp
+        x = np.array([0.5, 2.0, 3.5, 5.0, 8.0], np.float16)
+        got = _np(P.erfcx(_t(x))).astype(np.float64)
+        want = sp.erfcx(x.astype(np.float64))
+        assert np.all(np.isfinite(got))
+        np.testing.assert_allclose(got, want, rtol=2e-2)
