@@ -45,8 +45,16 @@ def reset():
 
 
 def init(role_maker=None, is_collective: bool = True,
-         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
-    """fleet.init — build the device mesh from strategy.hybrid_configs."""
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO",
+         devices=None):
+    """fleet.init — build the device mesh from strategy.hybrid_configs.
+
+    ``devices`` overrides the mesh's device set (default
+    ``jax.devices()``).  Detached-topology devices
+    (jax.experimental.topologies.get_topology_desc) are accepted: the
+    whole stack then LOWERS/COMPILES for that topology — AOT memory
+    planning on hardware this host doesn't have — but nothing can
+    execute (see tests/plan8b_aot_check.py)."""
     global _HCG, _STRATEGY
     # join the multi-host runtime first (no-op single-process): the mesh
     # below must span the GLOBAL device set
@@ -57,14 +65,14 @@ def init(role_maker=None, is_collective: bool = True,
     n_needed = (hybrid.dp_degree * hybrid.mp_degree * hybrid.pp_degree *
                 hybrid.sharding_degree * hybrid.sep_degree *
                 hybrid.ep_degree)
-    n_have = len(jax.devices())
+    n_have = len(devices) if devices is not None else len(jax.devices())
     if n_needed == 1 and n_have > 1:
         # no explicit topology: default all devices to dp (reference
         # behavior: fleet defaults to pure DP over visible devices).
         # Persist into the strategy so get_strategy() agrees with the mesh.
         hybrid.dp_degree = n_have
         strategy.hybrid_configs["dp_degree"] = n_have
-    _HCG = HybridCommunicateGroup(hybrid)
+    _HCG = HybridCommunicateGroup(hybrid, devices=devices)
     from .auto_parallel import set_mesh
     set_mesh(_HCG.mesh)
     from .collective import _set_default_group

@@ -441,9 +441,9 @@ def _attn_for_shape(q, k, v):
     from ..common.flags import get_flag
     from ..runtime.device import is_compiled_with_tpu
     if get_flag("use_pallas") and is_compiled_with_tpu():
-        from ..ops.pallas.flash_attention import flash_attention_raw
+        from ..ops.pallas.spmd import flash_attention_spmd
         try:
-            return flash_attention_raw(q, k, v, causal=True)
+            return flash_attention_spmd(q, k, v, causal=True)
         except NotImplementedError:
             pass
     from ..ops import _nn
@@ -479,17 +479,35 @@ def _decoder_layer_raw(lp, h, cos, sin, *, n_heads, n_kv, head_dim, eps,
 
 
 @functools.lru_cache(maxsize=32)
-def _pipe_stage_fn(n_heads, n_kv, head_dim, eps, rope_interleaved=False):
+def _pipe_stage_fn(n_heads, n_kv, head_dim, eps, rope_interleaved=False,
+                   remat_policy=None):
     """Stable per-config stage callable (the pipeline engine caches its
-    compiled form keyed on this object)."""
+    compiled form keyed on this object).
+
+    ``remat_policy``: None = no remat; "full" = jax.checkpoint each
+    layer; "core_attn"/"dots" = the jit/recompute.py named policies.
+    This is what config.recompute means INSIDE a pipeline stage — with
+    residual-stash 1F1B it also sets what the ring slots hold (the vjp
+    residuals of the checkpointed layer are just the policy's saveable
+    set), so core_attn shrinks the ring from full per-layer
+    intermediates to flash out+lse + layer inputs."""
     import jax
+
+    def layer_fn(lp, h, cos, sin):
+        return _decoder_layer_raw(
+            lp, h, cos, sin, n_heads=n_heads, n_kv=n_kv,
+            head_dim=head_dim, eps=eps,
+            rope_interleaved=rope_interleaved)
+
+    if remat_policy is not None:
+        from ..jit.recompute import _resolve_policy
+        pol = _resolve_policy(None if remat_policy == "full"
+                              else remat_policy)
+        layer_fn = jax.checkpoint(layer_fn, policy=pol)
 
     def stage_fn(locals_, h, cos, sin):
         def body(h, lp):
-            return _decoder_layer_raw(
-                lp, h, cos, sin, n_heads=n_heads, n_kv=n_kv,
-                head_dim=head_dim, eps=eps,
-                rope_interleaved=rope_interleaved), None
+            return layer_fn(lp, h, cos, sin), None
         h, _ = jax.lax.scan(body, h, tuple(locals_))
         return h
 
@@ -523,7 +541,7 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
                          n_heads, n_kv, head_dim, eps, num_stages, n_micro,
                          transpose_head, pp_axis="pp", n_virtual=1,
                          ignore_index=-100, rope_interleaved=False,
-                         stash_residuals=True):
+                         stash_residuals=True, remat_policy=None):
     """Decoder stack + loss head as one SPMD pipeline program; the loss
     is computed per microbatch on the last stage (raw jax level)."""
     import jax.numpy as jnp
@@ -533,7 +551,7 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
 
     pm = get_mesh()
     stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps,
-                              rope_interleaved)
+                              rope_interleaved, remat_policy)
     tail_fn = _pipe_tail_fn(eps, transpose_head, ignore_index)
     b = x.shape[0]
     n_layers = params[0].shape[0]
@@ -702,7 +720,9 @@ class LlamaForCausalLMPipe(Layer):
                 num_stages=None, n_micro=self.n_microbatches,
                 transpose_head=tied, n_virtual=self.virtual_pp_degree,
                 rope_interleaved=getattr(c, "rope_interleaved", False),
-                stash_residuals=getattr(c, "pp_stash_residuals", True))
+                stash_residuals=getattr(c, "pp_stash_residuals", True),
+                remat_policy=(c.recompute_granularity if c.recompute
+                              else None))
         x = apply_op(
             _llama_pipe_raw, stack, x, cos, sin,
             n_heads=c.num_attention_heads, n_kv=c.num_key_value_heads,

@@ -51,8 +51,8 @@ def _flash_kernel():
     global _FLASH_RAW
     if _FLASH_RAW == 0:
         try:
-            from ..ops.pallas.flash_attention import flash_attention_raw
-            _FLASH_RAW = flash_attention_raw
+            from ..ops.pallas.spmd import flash_attention_spmd
+            _FLASH_RAW = flash_attention_spmd
         except ImportError:
             _FLASH_RAW = None
     return _FLASH_RAW
@@ -123,13 +123,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                     import jax as _jax
 
                     from ..ops import random as _R
-                    from ..ops.pallas.flash_attention import \
-                        flash_attention_raw_ext
+                    from ..ops.pallas.spmd import \
+                        flash_attention_spmd_ext
                     seed = _jax.random.randint(
                         _R.split_key(), (), 0, 2**31 - 1,
                         dtype=jnp.int32) if dp > 0.0 \
                         else jnp.zeros((), jnp.int32)
-                    return apply_op(flash_attention_raw_ext, query, key,
+                    return apply_op(flash_attention_spmd_ext, query, key,
                                     value, mask, seed, causal=is_causal,
                                     dropout_p=dp,
                                     mask_grad=mask_trainable)

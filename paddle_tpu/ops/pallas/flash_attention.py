@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .vma import out_sds
+
 __all__ = ["flash_attention_raw", "flash_attention_bhsd",
            "flash_attention_bhsd_masked", "flash_attention_bhsd_bias"]
 
@@ -196,8 +198,8 @@ def _fwd(q, k, v, *, causal: bool, bq: int, bk: int, mask=None,
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
+            out_sds((b, h, sq, d), q.dtype, *args),
+            out_sds((b, h, sq, 8), jnp.float32, *args),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -388,7 +390,7 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None,
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=out_sds((b, h, sq, d), q.dtype, *dq_args),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
     )(*dq_args)
 
@@ -433,8 +435,8 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None,
                          lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hk, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, hk, sk, d), v.dtype),
+            out_sds((b, hk, sk, d), k.dtype, *dkv_args),
+            out_sds((b, hk, sk, d), v.dtype, *dkv_args),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -650,7 +652,7 @@ def _bwd_dmask(q, k, v, out, lse, do, mask, *, causal, bq, bk,
         grid=(mb, mh, nq, nk, rb, rh),
         in_specs=specs,
         out_specs=dm_spec,
-        out_shape=jax.ShapeDtypeStruct(mask.shape, mask.dtype),
+        out_shape=out_sds(mask.shape, mask.dtype, *args),
         scratch_shapes=[pltpu.VMEM((bq, bk), jnp.float32)],
     )(*args)
     return dm

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .vma import out_sds
+
 __all__ = ["grouped_matmul", "gmm_reference", "make_dropless_plan",
            "make_dropless_plan_rows", "dropless_moe_ffn",
            "dropless_moe_ffn_rows"]
@@ -107,7 +109,7 @@ def _gmm_call(lhs, w, tile_expert, *, transpose_w, tm, tc, tj,
             out_specs=pl.BlockSpec((tm, tj), lambda i, j, c, te: (i, j)),
             scratch_shapes=[pltpu.VMEM((tm, tj), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((m, j_dim), lhs.dtype),
+        out_shape=out_sds((m, j_dim), lhs.dtype, tile_expert, lhs, w),
         interpret=interpret,
     )(tile_expert.astype(jnp.int32), lhs, w)
     return out
@@ -159,7 +161,8 @@ def _gmm_dw_call(lhs, dout, tile_expert, counts, num_experts, *, tm, tk,
                                    lambda kk, j, i, te: (te[i], kk, j)),
             scratch_shapes=[pltpu.VMEM((tk, tn), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((num_experts, k, n), lhs.dtype),
+        out_shape=out_sds((num_experts, k, n), lhs.dtype, tile_expert,
+                          lhs, dout),
         interpret=interpret,
     )(tile_expert.astype(jnp.int32), lhs, dout)
     # experts with zero tiles were never visited — their blocks are

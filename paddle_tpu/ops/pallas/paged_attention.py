@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .vma import out_sds
+
 __all__ = ["paged_attention_raw", "paged_attention_reference",
            "paged_write", "paged_decode_append_attend",
            "paged_decode_append_attend_reference"]
@@ -190,7 +192,8 @@ def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
                 pltpu.SemaphoreType.DMA((_NBUF, 2)),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        out_shape=out_sds((b, kvh, g, d), q.dtype, page_table,
+                          seq_lens, qg, k_pages, v_pages),
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
       qg, k_pages, v_pages)
     return out.reshape(b, h, d)
@@ -291,9 +294,9 @@ def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            out_sds((b, kvh, g, d), q.dtype, qg, k_pages, v_pages),
+            out_sds(k_pages.shape, k_pages.dtype, qg, k_pages, v_pages),
+            out_sds(v_pages.shape, v_pages.dtype, qg, k_pages, v_pages),
         ],
         input_output_aliases={5: 1, 6: 2},
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),

@@ -1,0 +1,39 @@
+"""Varying-manual-axes plumbing for pallas_call inside shard_map.
+
+jax 0.9's ``check_vma=True`` shard_map (the default, and the mode the
+1F1B engine relies on for correct implicit-psum semantics) requires a
+``pallas_call``'s ``out_shape`` ShapeDtypeStructs to declare which
+manual mesh axes the outputs vary over.  Kernels can't know that
+statically — it depends on the caller's shard_map context — so
+:func:`out_sds` derives it at trace time as the union of the operands'
+vma sets (a kernel output varies over every axis any input varies
+over).  Outside shard_map the set is empty and a plain sds is built,
+so eager/jit call sites are unchanged.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["out_sds", "vma_union"]
+
+
+def vma_union(*arrays) -> frozenset:
+    """Union of the operands' varying-manual-axes sets (empty outside
+    shard_map).  The ONE accessor for jax's vma metadata — out_sds and
+    ops/pallas/spmd.py both go through it."""
+    vma = frozenset()
+    for a in arrays:
+        try:
+            vma |= frozenset(getattr(jax.typeof(a), "vma", ()) or ())
+        except Exception:  # noqa: BLE001 — non-array operands
+            pass
+    return vma
+
+
+def out_sds(shape, dtype, *like):
+    """ShapeDtypeStruct for a pallas_call out_shape inheriting the
+    union of ``like`` operands' varying-manual-axes."""
+    vma = vma_union(*like)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)

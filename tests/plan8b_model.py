@@ -17,6 +17,23 @@ LAYERS_TRUE = 32
 ACT_RESID_PER_LAYER = 5.1      # measured r4 (hand formula said 4)
 ACT_BASE = 2.95e9              # measured r4
 
+# Round 5: the 1F1B engines are ALSO compiler-measured, on the detached
+# v5p-64 topology itself (tests/plan8b_aot_check.py — real 'TPU v5'
+# compile targets, real Mosaic kernels, XLA memory_analysis per chip).
+# Plan B geometry (pp=4, mp=4, sharding=4, n_micro=8, core_attn remat
+# inside stages — config.recompute now applies IN the pipe stage fn):
+#   stash-residual ring (the pp_stash_residuals=True DEFAULT):
+#     temp 13.96 GB/chip;  input-ring recompute: temp 6.78 GB/chip.
+# The delta / (2S slots x layers_per_stage) calibrates the per-layer
+# ring residual under the core_attn policy (flash out + lse + layer
+# input, attention-dim pieces mp-sharded):
+STASH_RESID_PER_LAYER = 1.67   # [B,S,H]-bf16 equivalents, AOT-fitted
+AOT_TEMP_GB = {                # compiler ground truth, 32L true width
+    "plan_a": 24.02,           # ZeRO-3 dp8 x sh8, core_attn remat
+    "plan_b_stash": 13.96,     # fused-1F1B stash ring (DEFAULT)
+    "plan_b_recompute": 6.78,  # fused-1F1B input ring
+}
+
 
 def act_bytes(layers=LAYERS_TRUE, micro=1, seq=SEQ, hidden=HIDDEN):
     return (ACT_RESID_PER_LAYER * micro * seq * hidden * 2 * layers
