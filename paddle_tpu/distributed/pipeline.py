@@ -211,9 +211,10 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
                tail_cond: Optional[bool] = None):
     """Run ``stage_fn`` as a circulating SPMD pipeline.
 
-    params:   arrays stacked [n_chunks, ...] in global chunk order,
-              where n_chunks = pp_size * n_virtual; chunk l*S+d is
-              placed on device d as its lap-l virtual stage.
+    params:   v==1: arrays stacked [n_chunks, per, ...]; v>1: the
+              interleaved [S, v, per, ...] device-major layout (chunk
+              l*S+d at [d, l] — device d's lap-l virtual stage), so
+              pp shards dim 0 with no cross-shard relayout.
     x_micro:  [n_micro, micro_batch, ...] input microbatches (replicated
               over pp; may be sharded over data axes).
     stage_fn: (local_params_list, h, *extra) -> h, applied by every
@@ -234,16 +235,26 @@ def gpipe_spmd(params: Sequence[jax.Array], x_micro: jax.Array,
     the summed tail pytree when ``tail_fn`` is given.
     """
     nstage = mesh.shape[pp_axis]
-    n_chunks = params[0].shape[0]
+    n_chunks = params[0].shape[0] if n_virtual == 1 \
+        else params[0].shape[0] * params[0].shape[1]
     enforce(n_chunks == nstage * n_virtual,
-            f"stacked chunk dim {n_chunks} != mesh '{pp_axis}' size "
-            f"{nstage} * n_virtual {n_virtual}")
-    # interleaved placement: global chunk order [v*S, ...] -> [S, v, ...]
-    # so dim 0 shards over pp and dim 1 indexes the device's laps
-    stacked = []
-    for p in params:
-        q = p.reshape((n_virtual, nstage) + p.shape[1:])
-        stacked.append(jnp.swapaxes(q, 0, 1))
+            f"stacked chunk dims {tuple(params[0].shape)} != mesh "
+            f"'{pp_axis}' size {nstage} * n_virtual {n_virtual}")
+    # interleaved placement: stacks arrive ALREADY [S, v, per, ...]
+    # (device-major storage — see models' pipe classes): dim 0 shards
+    # over pp, dim 1 indexes the device's laps.  A global-chunk-order
+    # [v*S, ...] layout would need a cross-shard relayout here (SPMD
+    # involuntary full rematerialization of every stack, every step).
+    # v==1 gains a singleton lap dim (free — dim 0 stays sharded) so
+    # the engine slab is uniformly [S, v, per, ...].
+    if n_virtual > 1:
+        for p in params:
+            enforce(p.shape[0] == nstage and p.shape[1] == n_virtual,
+                    f"interleaved stacks must be [S={nstage}, "
+                    f"v={n_virtual}, per, ...]; got {p.shape}")
+        stacked = list(params)
+    else:
+        stacked = [p[:, None] for p in params]
     fn = _jitted_pipeline(stage_fn, mesh, pp_axis, len(params),
                           len(extra), remat, n_virtual, tail_fn,
                           len(tail_params), len(tail_indexed),
@@ -682,8 +693,10 @@ def pipeline_train_1f1b(stage_fn, tail_fn, mesh, pp_axis, stacked,
     fused 1F1B loop ONCE, producing loss and all gradients together
     (ring buffers ⇒ activation memory ∝ pp, not n_micro); without grad,
     the plain forward pipeline runs (cond-guarded tail).
-    stacked: tuple of [n_virtual*S, per_chunk, ...] arrays in global
-    chunk order.  ``stash``: ring-buffer VJP residuals so backward
+    stacked: v==1: tuple of [S, per_chunk, ...] arrays; v>1: the
+    interleaved [S, v, per_chunk, ...] device-major layout (chunk
+    l*S+d at [d, l]) — never global chunk order, so no cross-shard
+    relayout happens.  ``stash``: ring-buffer VJP residuals so backward
     ticks skip the forward recompute (see _jitted_1f1b)."""
     loss_sum, count = gpipe_spmd(
         list(stacked), x_micro, stage_fn, *extra, mesh=mesh,
@@ -699,22 +712,16 @@ def _ptrain_1f1b_fwd(stage_fn, tail_fn, mesh, pp_axis, stacked, x_micro,
     eng = _jitted_1f1b(stage_fn, tail_fn, mesh, pp_axis, len(stacked),
                        len(extra), len(tail_params), len(tail_indexed),
                        stash, n_virtual)
-    v = n_virtual
-    nstage = mesh.shape[pp_axis]
-    if v > 1:
-        # interleaved placement: [v*S, per, ...] -> [S, v, per, ...]
-        eng_stacked = tuple(
-            jnp.swapaxes(p.reshape((v, nstage) + p.shape[1:]), 0, 1)
-            for p in stacked)
-    else:
-        eng_stacked = tuple(stacked)
-    lsum, cnt, gp, dxm, gt = eng(eng_stacked, x_micro, *extra,
+    # v>1 stacks arrive already in [S, v, per, ...] engine layout;
+    # gradients come back in the same layout — no relayout either way
+    if n_virtual > 1:
+        nstage = mesh.shape[pp_axis]
+        for p in stacked:
+            enforce(p.shape[0] == nstage and p.shape[1] == n_virtual,
+                    f"interleaved stacks must be [S={nstage}, "
+                    f"v={n_virtual}, per, ...]; got {p.shape}")
+    lsum, cnt, gp, dxm, gt = eng(tuple(stacked), x_micro, *extra,
                                  *tail_params, *tail_indexed)
-    if v > 1:
-        # [S, v, per, ...] grads back to global chunk order
-        gp = tuple(
-            jnp.swapaxes(g, 0, 1).reshape((v * nstage,) + g.shape[2:])
-            for g in gp)
     denom = jnp.maximum(cnt, 1.0)
     loss = lsum / denom
     # cotangents must come back in the primal dtypes; scale-by-ct in

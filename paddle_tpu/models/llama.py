@@ -537,6 +537,47 @@ def _pipe_tail_fn(eps, transpose_head, ignore_index):
     return tail_fn
 
 
+def _pipe_n_layers(p, n_virtual):
+    """Layer count of a stacked pipe param: [L, ...] when v==1,
+    [S, v, per, ...] interleaved storage when v>1."""
+    return p.shape[0] if n_virtual == 1 \
+        else p.shape[0] * p.shape[1] * p.shape[2]
+
+
+def _pipe_layer_view(params, n_virtual, n_layers):
+    """Global layer-order [L, ...] view of the stacks for the serial
+    (no-mesh) path.  v>1 storage is [S(d), v(lap), per, ...] with chunk
+    c = lap*S + d, so layer order = swap the (d, lap) dims and flatten
+    — a host-cheap transpose on unsharded arrays."""
+    import jax.numpy as jnp
+    if n_virtual == 1:
+        return list(params)
+    return [jnp.swapaxes(p, 0, 1).reshape((n_layers,) + p.shape[3:])
+            for p in params]
+
+
+def _pipe_chunked(params, num_stages, n_virtual, n_layers):
+    """Engine-layout chunk stacks: v==1 reshapes [L] -> [S, per] (an
+    efficient dim-0 split of the pp-sharded dim); v>1 storage is
+    ALREADY [S, v, per, ...] — pass through untouched, so no relayout
+    (and no involuntary SPMD rematerialization) ever happens."""
+    n_chunks = num_stages * n_virtual
+    if n_layers % n_chunks:
+        raise ValueError(
+            f"num_hidden_layers={n_layers} must divide evenly over "
+            f"pp_degree={num_stages} * virtual_pp_degree={n_virtual}")
+    if n_virtual > 1:
+        for p in params:
+            if p.shape[0] != num_stages or p.shape[1] != n_virtual:
+                raise ValueError(
+                    f"interleaved stacks must be [S={num_stages}, "
+                    f"v={n_virtual}, per, ...]; got {p.shape}")
+        return list(params)
+    per_chunk = n_layers // n_chunks
+    return [p.reshape((n_chunks, per_chunk) + p.shape[1:])
+            for p in params]
+
+
 def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
                          n_heads, n_kv, head_dim, eps, num_stages, n_micro,
                          transpose_head, pp_axis="pp", n_virtual=1,
@@ -554,14 +595,15 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
                               rope_interleaved, remat_policy)
     tail_fn = _pipe_tail_fn(eps, transpose_head, ignore_index)
     b = x.shape[0]
-    n_layers = params[0].shape[0]
+    n_layers = _pipe_n_layers(params[0], n_virtual)
 
     pp = pm.mesh.shape.get(pp_axis, 1) if pm is not None else 1
     if num_stages is None:
         num_stages = pp
     if pm is None or pp <= 1 or num_stages <= 1:
         # serial fallback never microbatches — no divisibility demands
-        h = stage_fn(list(params), x, cos, sin)
+        h = stage_fn(_pipe_layer_view(params, n_virtual, n_layers),
+                     x, cos, sin)
         loss_sum, count = tail_fn((norm_w, head_w), h,
                                   labels)
         return loss_sum / jnp.maximum(count, 1.0)
@@ -572,14 +614,7 @@ def _llama_pipe_loss_raw(params, x, labels, cos, sin, norm_w, head_w, *,
     xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
     lm = labels.reshape((n_micro, b // n_micro) + labels.shape[1:])
 
-    n_chunks = num_stages * n_virtual
-    if n_layers % n_chunks:
-        raise ValueError(
-            f"num_hidden_layers={n_layers} must divide evenly over "
-            f"pp_degree={num_stages} * virtual_pp_degree={n_virtual}")
-    per_chunk = n_layers // n_chunks
-    stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
-               for p in params]
+    stacked = _pipe_chunked(params, num_stages, n_virtual, n_layers)
     # training default: fused 1F1B schedule — interleaved when
     # n_virtual > 1 (activation memory ∝ pp in-flight microbatches,
     # not n_micro); custom_vjp, so this is also the eval path (plain
@@ -605,7 +640,7 @@ def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
     from ..distributed.auto_parallel import get_mesh
     from ..distributed.pipeline import gpipe_spmd
 
-    n_layers = params[0].shape[0]
+    n_layers = _pipe_n_layers(params[0], n_virtual)
     stage_fn = _pipe_stage_fn(n_heads, n_kv, head_dim, eps,
                               rope_interleaved)
 
@@ -616,17 +651,10 @@ def _llama_pipe_raw(params, x, cos, sin, *, n_heads, n_kv, head_dim, eps,
 
     if pm is None or pp <= 1 or num_stages <= 1:
         # no pipeline axis: plain scan over layers (single-chip / dp-only)
-        return stage_fn(list(params), x, cos, sin)
+        return stage_fn(_pipe_layer_view(params, n_virtual, n_layers),
+                        x, cos, sin)
 
-    n_chunks = num_stages * n_virtual
-    if n_layers % n_chunks:
-        raise ValueError(
-            f"num_hidden_layers={n_layers} must divide evenly over "
-            f"pp_degree={num_stages} * virtual_pp_degree={n_virtual} "
-            f"chunks")
-    per_chunk = n_layers // n_chunks
-    stacked = [p.reshape((n_chunks, per_chunk) + p.shape[1:])
-               for p in params]
+    stacked = _pipe_chunked(params, num_stages, n_virtual, n_layers)
     b = x.shape[0]
     if b % n_micro:
         raise ValueError(
@@ -648,7 +676,8 @@ class LlamaForCausalLMPipe(Layer):
     """
 
     def __init__(self, config: LlamaConfig, n_microbatches: int = 4,
-                 virtual_pp_degree: int = 1):
+                 virtual_pp_degree: int = 1,
+                 num_stages: Optional[int] = None):
         super().__init__()
         self.config = config
         self.n_microbatches = n_microbatches
@@ -661,9 +690,41 @@ class LlamaForCausalLMPipe(Layer):
                           math.sqrt(2 * c.num_hidden_layers))
         L, H = c.num_hidden_layers, c.hidden_size
 
+        v = virtual_pp_degree
+        if v > 1:
+            # INTERLEAVED storage: device d owns chunks d, d+S, ... so
+            # stacks live as [S, v, per_chunk, ...] with pp on dim 0 —
+            # the exact per-device layout the engine consumes.  Storing
+            # global chunk order [v*S, ...] instead forces an
+            # involuntary-full-rematerialization reshard of EVERY stack
+            # each step (the [vS]->[S,v] relayout moves weights across
+            # pp shards; surfaced by the r4 dryrun's SPMD warnings).
+            # S must therefore be known at construction (the reference's
+            # interleaved PipelineLayer takes the topology then too).
+            if num_stages is None:
+                from ..distributed.auto_parallel import get_mesh
+                pm = get_mesh()
+                from ..common.errors import enforce
+                enforce(pm is not None and pm.mesh.shape.get("pp", 1) > 1,
+                        "virtual_pp_degree > 1 needs num_stages= or an "
+                        "active pp mesh at construction")
+                num_stages = int(pm.mesh.shape["pp"])
+            from ..common.errors import enforce
+            enforce(L % (num_stages * v) == 0,
+                    f"num_hidden_layers={L} must divide over "
+                    f"pp {num_stages} * virtual_pp_degree {v}")
+        self.num_stages = num_stages
+        per = L // (num_stages * v) if v > 1 else None
+
         def stacked(shape, ini, spec):
-            p = self.create_parameter([L] + shape, default_initializer=ini)
-            p.dist_spec = ("pp",) + spec
+            if v > 1:
+                p = self.create_parameter([num_stages, v, per] + shape,
+                                          default_initializer=ini)
+                p.dist_spec = ("pp", None, None) + spec
+            else:
+                p = self.create_parameter([L] + shape,
+                                          default_initializer=ini)
+                p.dist_spec = ("pp",) + spec
             return p
 
         self.input_ln = stacked([H], Constant(1.0), (None,))

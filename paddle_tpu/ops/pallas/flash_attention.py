@@ -700,21 +700,14 @@ def flash_attention_bhsd_bias(q, k, v, bias, causal: bool, bq: int,
                              bk, dropout_p)
 
 
-def flash_attention_raw(q, k, v, causal: bool = False, mask=None,
-                        dropout_p: float = 0.0, seed=None,
-                        mask_grad: bool = False):
-    """[B, S, H, D] entry used by F.scaled_dot_product_attention.
-
-    Causal with sq < sk treats Q as the LAST sq positions (KV-cache
-    decode / chunked prefill).  ``mask`` is an ADDITIVE bias broadcast
-    as [B|1, H|1, Sq|1, Sk]; pass ``mask_grad=True`` for a TRAINED bias
-    (real dbias via the dmask kernel; requires full Sq).  ``dropout_p``
-    runs in-kernel attention dropout seeded by the int32 ``seed``.
-    Raises on shapes the kernel does not cover (caller falls back to
-    the jnp reference): sq > sk causal, tiny/odd dims.
-    """
-    b, sq, h, d = q.shape
-    sk, hk = k.shape[1], k.shape[2]
+def check_eligibility(sq, sk, h, hk, d, *, causal, dropout_p,
+                      mask_grad):
+    """THE shape-rule gate for the flash kernel (single source — both
+    flash_attention_raw and the GSPMD wrapper ops/pallas/spmd.py call
+    it, the latter on per-shard local shapes).  Returns the (bq, bk)
+    block sizes; raises NotImplementedError for uncovered shapes (the
+    callers' documented jnp-fallback signal) and ValueError for
+    invalid dropout."""
     if not 0.0 <= dropout_p < 1.0:
         # the kernel's keep-threshold is a uint32 compare: p >= 1 would
         # clamp to keep-with-prob-2^-32 and the 1/(1-p) rescale
@@ -731,6 +724,26 @@ def flash_attention_raw(q, k, v, causal: bool = False, mask=None,
         # + rescaled-prob intermediates blow the 16M scoped-vmem limit
         # at 1024-wide blocks (observed on v5e at d=64): stay at 512
         bq, bk = min(bq, 512), min(bk, 512)
+    return bq, bk
+
+
+def flash_attention_raw(q, k, v, causal: bool = False, mask=None,
+                        dropout_p: float = 0.0, seed=None,
+                        mask_grad: bool = False):
+    """[B, S, H, D] entry used by F.scaled_dot_product_attention.
+
+    Causal with sq < sk treats Q as the LAST sq positions (KV-cache
+    decode / chunked prefill).  ``mask`` is an ADDITIVE bias broadcast
+    as [B|1, H|1, Sq|1, Sk]; pass ``mask_grad=True`` for a TRAINED bias
+    (real dbias via the dmask kernel; requires full Sq).  ``dropout_p``
+    runs in-kernel attention dropout seeded by the int32 ``seed``.
+    Raises on shapes the kernel does not cover (caller falls back to
+    the jnp reference): sq > sk causal, tiny/odd dims.
+    """
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    bq, bk = check_eligibility(sq, sk, h, hk, d, causal=causal,
+                               dropout_p=dropout_p, mask_grad=mask_grad)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)

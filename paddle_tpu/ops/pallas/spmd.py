@@ -217,7 +217,7 @@ def flash_attention_spmd(q, k, v, causal=False, mask=None,
     where the raw kernel would (per-shard shapes), so callers'
     jnp-fallback handling is unchanged."""
     from ...distributed.auto_parallel import get_mesh
-    from .flash_attention import _pick_blocks, _tag, flash_attention_raw
+    from .flash_attention import _tag, flash_attention_raw
 
     pm = get_mesh()
     mesh = pm.mesh if pm is not None else None
@@ -239,17 +239,11 @@ def flash_attention_spmd(q, k, v, causal=False, mask=None,
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     nh = int(np.prod([mesh.shape[a] for a in head_axes], dtype=np.int64))
-    lh, lhk = h // nh, hk // nh
-    # mirror flash_attention_raw's eligibility rules on LOCAL shapes
-    if not 0.0 <= dropout_p < 1.0:
-        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
-    if causal and sq > sk:
-        raise NotImplementedError("causal flash kernel needs sq <= sk")
-    if d not in (64, 128, 256) or lh % lhk or sq % 8 or sk % 8:
-        raise NotImplementedError("flash kernel shape constraints")
-    bq, bk = _pick_blocks(sq, sk, d)
-    if mask_grad or dropout_p > 0.0:
-        bq, bk = min(bq, 512), min(bk, 512)
+    # the kernel's shared shape gate, on per-shard LOCAL shapes
+    from .flash_attention import check_eligibility
+    bq, bk = check_eligibility(sq, sk, h // nh, hk // nh, d,
+                               causal=causal, dropout_p=dropout_p,
+                               mask_grad=mask_grad)
 
     bspec = tuple(batch_axes) if batch_axes else None
     hspec = tuple(head_axes) if head_axes else None

@@ -93,7 +93,7 @@ def test_8b_engines_compile_for_detached_v5p():
     """Round-5: the 1F1B ENGINES' compiled memory, from the TPU
     compiler itself — jax detached-topology AOT compiles the true-width
     pipe train step for real 'TPU v5' targets on this chipless host and
-    reads memory_analysis().  Asserts (small pp=2 x mp=2 geometry, 4
+    reads memory_analysis().  Asserts (small pp=2 x mp=2 geometry, 2
     layers, core_attn remat): both schedules compile; the shipped
     stash-residual default costs more temp than the recompute ring but
     both fit; the q weights are genuinely pp-split AND mp-sharded.

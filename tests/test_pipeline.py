@@ -171,7 +171,8 @@ def test_pipe_interleaved_virtual_stages_match_serial():
     interleaved 1F1B engine equal the no-mesh serial model (round-4:
     training no longer falls back to AD-through-the-gpipe-loop)."""
     cfg = _cfg4()   # 4 layers over pp=2 * v=2 -> 1 layer per chunk
-    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=4, virtual_pp_degree=2)
+    pipe = LlamaForCausalLMPipe(cfg, n_microbatches=4, virtual_pp_degree=2,
+                            num_stages=2)
     ids, labels = _batch(cfg, b=8, seed=5)
 
     saved = auto_parallel._GLOBAL_MESH
@@ -302,10 +303,14 @@ def _toy_1f1b_setup(nm, s=4, h=32, mb=4, per=2, seed=0, v=1):
         return jnp.sum((z - lbl) ** 2), jnp.asarray(z.size, jnp.float32)
 
     rng = np.random.default_rng(seed)
+    # v>1: engine layout [S, v, per, h, h] — storage[d, lap] is global
+    # chunk lap*s + d (use chunk_of(ci) below to index serially)
     ws = jnp.asarray(rng.standard_normal((v * s, per, h, h)) * 0.1,
                      jnp.float32)
     if v == 1:
         ws = ws.reshape((s, per, h, h))
+    else:
+        ws = jnp.swapaxes(ws.reshape((v, s, per, h, h)), 0, 1)
     xm = jnp.asarray(rng.standard_normal((nm, mb, h)), jnp.float32)
     lm = jnp.asarray(rng.standard_normal((nm, mb, h)), jnp.float32)
     vw = jnp.asarray(rng.standard_normal((h, h)) * 0.1, jnp.float32)
@@ -372,7 +377,7 @@ def test_interleaved_1f1b_loss_and_grads_match_serial(s, v, nm, stash):
         x = xm.reshape(nm * mb, h)
         for ci in range(v * s):
             for pi in range(per):
-                x = jnp.tanh(x @ ws[ci, pi])
+                x = jnp.tanh(x @ ws[ci % s, ci // s, pi])
         z = x @ vw
         return jnp.sum((z - lm.reshape(nm * mb, h)) ** 2) / (nm * mb * h)
 
@@ -483,14 +488,19 @@ def test_pipe_1f1b_training_grads_match_serial_model():
     assert n_checked >= 5
 
 
-def test_pipe_recompute_policy_grads_match(no_mesh):
+def test_pipe_recompute_policy_grads_match():
     """config.recompute now applies INSIDE pipe stages (round 5 —
     before, stash-1F1B ring slots buffered FULL per-layer residuals;
     the v5p AOT check measured 2.75x temp memory from that).  Remat
-    must be semantics-preserving: loss and grads identical with and
-    without it, in both 1F1B engines."""
+    must be semantics-preserving THROUGH THE ENGINES: on a pp=2 mesh,
+    loss and grads with the checkpoint policy active equal the
+    no-remat run, in both 1F1B backward modes (the stash mode
+    ring-buffers the CHECKPOINTED layer's vjp residuals — exactly the
+    capture this guards)."""
     base = llama_tiny_config()
     ids, labels = _batch(base, seed=7)
+    ref = LlamaForCausalLM(base)
+    _pp_mesh(2)
 
     def run(recompute, stash):
         cfg = llama_tiny_config()
@@ -498,17 +508,12 @@ def test_pipe_recompute_policy_grads_match(no_mesh):
         cfg.recompute_granularity = "core_attn"
         cfg.pp_stash_residuals = stash
         pipe = LlamaForCausalLMPipe(cfg, n_microbatches=2)
-        _sync(pipe)
+        _copy_weights(ref, pipe)
         loss = pipe(ids, labels=labels)
         loss.backward()
         return (float(loss.numpy()),
                 np.asarray(pipe.q_w.grad.numpy()),
                 np.asarray(pipe.embed_tokens.weight.grad.numpy()))
-
-    ref = LlamaForCausalLM(base)
-
-    def _sync(pipe):
-        _copy_weights(ref, pipe)
 
     for stash in (True, False):
         l0, gq0, ge0 = run(False, stash)
