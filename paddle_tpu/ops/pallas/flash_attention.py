@@ -347,15 +347,21 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, group, off,
 
 
 def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None,
-              dropout_p: float = 0.0, seed=None):
+              dropout_p: float = 0.0, seed=None, delta=None,
+              out_dtype=None):
+    """``delta`` (precomputed rowsum(dO*O) [b, h, sq] f32) and
+    ``out_dtype`` (f32 for callers that accumulate across calls, e.g.
+    the context-parallel ring backward — avoids quantizing each hop's
+    partials to bf16 first) are optional."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = h // hk
     nq, nk = sq // bq, sk // bk
     scale = 1.0 / math.sqrt(d)
 
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)                                  # [b, h, sq]
+    if delta is None:
+        delta = jnp.sum(out.astype(jnp.float32)
+                        * do.astype(jnp.float32), axis=-1)    # [b, h, sq]
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
     off = sk - sq
     seed_arr = (jnp.asarray(seed, jnp.int32).reshape(1)
@@ -390,7 +396,8 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None,
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-        out_shape=out_sds((b, h, sq, d), q.dtype, *dq_args),
+        out_shape=out_sds((b, h, sq, d), out_dtype or q.dtype,
+                          *dq_args),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
     )(*dq_args)
 
@@ -435,8 +442,8 @@ def _bwd_impl(q, k, v, out, lse, do, *, causal, bq, bk, mask=None,
                          lambda b_, hk_, ik, g_, iq: (b_, hk_, ik, 0)),
         ],
         out_shape=[
-            out_sds((b, hk, sk, d), k.dtype, *dkv_args),
-            out_sds((b, hk, sk, d), v.dtype, *dkv_args),
+            out_sds((b, hk, sk, d), out_dtype or k.dtype, *dkv_args),
+            out_sds((b, hk, sk, d), out_dtype or v.dtype, *dkv_args),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),

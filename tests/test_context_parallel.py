@@ -154,3 +154,33 @@ class TestModelLevelSep:
         np.testing.assert_allclose(losses_ref, losses_cp, rtol=2e-3,
                                    atol=2e-3)
         assert losses_cp[-1] < losses_cp[0]
+
+
+def test_cp_flash_backward_parity_on_tpu():
+    """Real-chip parity of the ring backward's Pallas chunk kernels
+    (diag + full blocks with global statistics) vs the f32 einsum
+    oracle — runs tests/cp_bwd_check.py standalone (the axon tunnel
+    grants one process the chip; a pytest parent already holds it, so
+    this skips in-suite and the driver/verify recipe runs the script
+    directly)."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("XLA_FLAGS", None)
+    import importlib.util
+    env["JAX_PLATFORMS"] = ("axon" if importlib.util.find_spec("axon")
+                            else "tpu")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "cp_bwd_check.py")
+    proc = subprocess.run([sys.executable, worker], env=env,
+                          capture_output=True, text=True, timeout=580)
+    if proc.returncode == 86:
+        pytest.skip("no TPU backend reachable")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    res = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert res["parity"]["diag"]["max_rel_err"] < 5e-2
+    assert res["parity"]["full"]["max_rel_err"] < 5e-2
