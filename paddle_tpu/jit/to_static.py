@@ -24,20 +24,21 @@ The returned StaticFunction:
     attempts), and the break is logged + counted
     (``.graph_break_count``).  ``full_graph=True`` keeps the strict
     contract and re-raises.
-  * **compiled-prefix capture** (round 4, SOT's compiled-segment
-    behavior): the breaking call records the pre-break op stream while
-    running eagerly; subsequent same-signature calls execute the
-    prefix as ONE jitted XLA program and substitute its results
-    op-by-op under guards (jit/prefix.py), so only the post-break tail
-    pays eager dispatch.  Stats: ``prefix_op_count``,
-    ``prefix_replay_count``, ``last_replayed_ops``.  Only NON-diff
-    ops are captured — under grad mode the prefix closes at the first
-    grad-path op (the tape needs its per-op vjps) and the prefix
-    cache keys on grad mode + arg stop-gradient flags.  On the one
-    breaking call, python side effects before the
-    break run twice (the aborted trace + the recording run);
-    tensor/layer state is unaffected (functional_state and rng_guard
-    unwind the aborted trace).
+  * **compiled-segment capture** (round 5, SOT's compiled-segment
+    behavior): the breaking call records its WHOLE op stream while
+    running eagerly, split into segments at host reads (and at
+    unguardable RNG/unhashable ops, which replay eagerly between
+    them); subsequent same-signature calls execute each segment as
+    ONE jitted XLA program — in grad mode as one ``jax.vjp`` feeding
+    a single tape GradNode, so broken TRAIN steps run compiled on
+    both sides of every break — substituting results op-by-op under
+    guards (jit/prefix.py).  Stats: ``prefix_op_count``,
+    ``prefix_segment_count``, ``prefix_replay_count``,
+    ``last_replayed_ops``.  The cache keys on grad mode + arg
+    stop-gradient flags.  On the one breaking call, python side
+    effects before the break run twice (the aborted trace + the
+    recording run); tensor/layer state is unaffected
+    (functional_state and rng_guard unwind the aborted trace).
 
 Known functional-purity caveat (documented parity gap): BatchNorm
 running-stat mutation inside a to_static region is reverted at trace
@@ -114,6 +115,7 @@ class StaticFunction:
         # prefix segment / calls served by its compiled replay / ops
         # substituted on the most recent replayed call
         self.prefix_op_count = 0
+        self.prefix_segment_count = 0
         self.prefix_replay_count = 0
         self.last_replayed_ops = 0
         functools.update_wrapper(self, function)
@@ -225,19 +227,19 @@ class StaticFunction:
 
     def _eager_with_prefix(self, key, args, kwargs, flat_args,
                            tensor_idx):
-        """Eager execution of a graph-broken signature, with SOT-style
-        compiled-prefix capture: the first eager run records the
-        pre-break op stream; later runs replay it as ONE jitted call
-        and substitute its results op-by-op (see jit/prefix.py).
-        Only NON-diff ops are captured (the recorder closes the prefix
-        at the first grad-path op — the eager tape wants per-op vjps
-        that substituted results don't carry), and the prefix cache is
-        keyed on the arg stop-gradient flags + grad mode so an op's
-        diff-ness cannot differ between recording and replay."""
+        """Eager execution of a graph-broken signature with SOT-style
+        compiled-SEGMENT capture (round 5): the first eager run records
+        the WHOLE op stream as segments split at host reads (and at
+        unguardable RNG/unhashable ops, which replay eagerly between
+        them); later runs execute each segment as ONE compiled call —
+        a jax.vjp feeding a single tape GradNode in grad mode, so
+        broken TRAIN steps run compiled too — substituting results
+        op-by-op under guards (see jit/prefix.py).  The cache is keyed
+        on arg stop-gradient flags + grad mode so an op's diff-ness
+        cannot differ between recording and replay."""
         from ..autograd import tape
         from ..tensor import set_op_observer
-        from .prefix import (PrefixRecorder, PrefixReplayer,
-                             build_prefix_replay)
+        from .prefix import PrefixRecorder, PrefixReplayer
 
         layer = self._layer
         if key is None:
@@ -267,33 +269,41 @@ class StaticFunction:
                 out = self._function(*args, **kwargs)
             finally:
                 set_op_observer(prev)
-            if rec.ops:
-                self._prefix_cache[key] = (rec, build_prefix_replay(rec))
+            rec.seal()
+            if rec.captured_op_count:
+                self._prefix_cache[key] = rec
                 self.prefix_op_count = len(rec.ops)
-                rec.seal()
+                self.prefix_segment_count = sum(
+                    1 for kind, _ in rec.items if kind == "seg")
             else:
                 self._prefix_cache[key] = False     # nothing capturable
             return out
 
-        rec, jitted = entry
+        rec = entry
         named = dict(layer.named_parameters()) if layer is not None \
             else {}
         bufs = dict(layer.named_buffers()) if layer is not None else {}
 
         def fetch(desc):
+            """(array, Tensor-or-None) for an ext descriptor — the
+            Tensor carries the tape edge for grad-mode segments."""
             kind, ref = desc
             if kind == "param":
-                return named[ref].value
+                t = named[ref]
+                return t.value, t
             if kind == "buffer":
-                return bufs[ref].value
+                return bufs[ref].value, None
             if kind == "arg":
                 a = flat_args[ref]
-                return a.value if isinstance(a, Tensor) else a
-            return rec.consts[ref]                    # const
+                if isinstance(a, Tensor):
+                    return a.value, a
+                return a, None
+            if kind == "tensor":        # pinned closure Tensor (param)
+                t = rec.ext_tensors[ref]
+                return t.value, t
+            return rec.consts[ref], None              # const
 
-        ext_arrays = [fetch(d) for d in rec.ext_desc]
-        prefix_flat = jitted(ext_arrays)
-        rep = PrefixReplayer(rec, prefix_flat, ext_arrays)
+        rep = PrefixReplayer(rec, fetch, tape.is_grad_enabled())
         prev = set_op_observer(rep)
         try:
             out = self._function(*args, **kwargs)
@@ -301,9 +311,9 @@ class StaticFunction:
             set_op_observer(prev)
         self.prefix_replay_count += 1
         self.last_replayed_ops = rep.replayed
-        if rep.replayed < max(1, len(rec.ops) // 2):
-            # guards bailed early: running the whole compiled prefix
-            # then recomputing most of it eagerly costs ~2x — evict
+        if rep.replayed < max(1, rec.captured_op_count // 2):
+            # guards bailed early: running compiled segments then
+            # recomputing most ops eagerly costs ~2x — evict
             self._prefix_cache[key] = False
         return out
 

@@ -435,12 +435,26 @@ def apply_op(raw_fn, *args, **kwargs):
             if sub is not OBS_MISS:
                 return _wrap_out(sub, node=None, opname=opname)
         out = raw_fn(*rebuild(arrays), **kwargs)
+        res = _wrap_out(out, node=None, opname=opname)
         if obs is not None:
-            obs.on_result(raw_fn, template, kwargs, arrays, out)
-        return _wrap_out(out, node=None, opname=opname)
-    if _OP_OBSERVER is not None:
-        # grad-path ops are not captured — close the recorded prefix
-        _OP_OBSERVER.on_host_read()
+            obs.on_result(raw_fn, template, kwargs, arrays, out,
+                          leaves=leaves)
+            wrapped_hook = getattr(obs, "on_result_wrapped", None)
+            if wrapped_hook is not None:
+                wrapped_hook(res)
+        return res
+    obs = _OP_OBSERVER
+    if obs is not None:
+        # segment capture handles grad-path ops (jit/prefix.py round 5);
+        # observers without the hook close the capture instead
+        diff_hook = getattr(obs, "on_diff_op", None)
+        if diff_hook is None:
+            obs.on_host_read()
+        else:
+            sub = diff_hook(raw_fn, template, kwargs, arrays, diff_idx,
+                            leaves=leaves)
+            if sub is not OBS_MISS:
+                return sub        # fully wrapped (segment-node tensors)
 
     def f(*diff_arrays):
         full = list(arrays)
@@ -466,7 +480,16 @@ def apply_op(raw_fn, *args, **kwargs):
         opname, vjp_fn, in_edges, len(flat), out_tree,
         saved=(raw_fn, tuple(template), dict(kwargs), list(leaves),
                list(diff_idx), list(arrays)))
-    return _wrap_out(primal, node=node, opname=opname)
+    res = _wrap_out(primal, node=node, opname=opname)
+    if obs is not None:
+        diff_res = getattr(obs, "on_diff_result", None)
+        if diff_res is not None:
+            diff_res(raw_fn, template, kwargs, arrays, primal,
+                     diff_idx, leaves=leaves)
+            wrapped_hook = getattr(obs, "on_result_wrapped", None)
+            if wrapped_hook is not None:
+                wrapped_hook(res)
+    return res
 
 
 def _check_nan_inf(opname: str, arrays):
