@@ -506,10 +506,10 @@ def bench_moe_deepseek():
                       "grouped_ms_per_layer": round(t_g * 1e3, 2),
                       "dense_ms_per_layer": round(t_d * 1e3, 2),
                       "note": "marginal (len40-len8)/32 in-graph; "
-                              "grouped~dense parity within tunnel "
-                              "session noise (0.83-1.12x observed); "
-                              "r3's auto tile here was a consistent "
-                              "1.39x SLOWER than dense"}}
+                              "r5: fused gate|up GLU kernel + "
+                              "tm=256/full-K retune -> ~0.96x dense "
+                              "(padding-bound at 64E, see BASELINE.md "
+                              "5b); r3's auto tile was 1.39x SLOWER"}}
 
 
 def bench_paged_kernel():

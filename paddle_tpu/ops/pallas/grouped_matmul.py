@@ -34,7 +34,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .vma import out_sds
 
-__all__ = ["grouped_matmul", "gmm_reference", "make_dropless_plan",
+__all__ = ["grouped_matmul", "glu_grouped", "gmm_reference",
+           "make_dropless_plan",
            "make_dropless_plan_rows", "dropless_moe_ffn",
            "dropless_moe_ffn_rows"]
 
@@ -113,6 +114,139 @@ def _gmm_call(lhs, w, tile_expert, *, transpose_w, tm, tc, tj,
         interpret=interpret,
     )(tile_expert.astype(jnp.int32), lhs, w)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fused gate|up GLU: hs = silu(lhs @ wg[e]) * (lhs @ wu[e]) in ONE pass
+# ---------------------------------------------------------------------------
+
+def _gmm_glu_kernel(te_ref, lhs_ref, wg_ref, wu_ref, *refs, nc,
+                    save_pre):
+    """Two dots per tile visit — the lhs block is loaded ONCE for both
+    the gate and up projections, and the silu*mul epilogue runs on the
+    accumulators in VMEM (no hg/hu round-trip through HBM on the
+    forward-only path).  ``save_pre`` additionally emits the
+    pre-activation hg/hu (the training path's backward needs them)."""
+    if save_pre:
+        hs_ref, hg_ref, hu_ref, accg_ref, accu_ref = refs
+    else:
+        hs_ref, accg_ref, accu_ref = refs
+        hg_ref = hu_ref = None
+    ic = pl.program_id(2)
+
+    @pl.when(ic == 0)
+    def _():
+        accg_ref[...] = jnp.zeros_like(accg_ref)
+        accu_ref[...] = jnp.zeros_like(accu_ref)
+
+    a = lhs_ref[...].astype(jnp.float32)                   # [tm, tc]
+    dims = (((1,), (0,)), ((), ()))
+    accg_ref[...] += jax.lax.dot_general(
+        a, wg_ref[0].astype(jnp.float32), dims,
+        preferred_element_type=jnp.float32)
+    accu_ref[...] += jax.lax.dot_general(
+        a, wu_ref[0].astype(jnp.float32), dims,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ic == nc - 1)
+    def _():
+        g = accg_ref[...]
+        u = accu_ref[...]
+        hs_ref[...] = (jax.nn.silu(g) * u).astype(hs_ref.dtype)
+        if save_pre:
+            hg_ref[...] = g.astype(hg_ref.dtype)
+            hu_ref[...] = u.astype(hu_ref.dtype)
+
+
+def _gmm_glu_call(lhs, wg, wu, tile_expert, *, tm, tc, tj, save_pre,
+                  interpret=False):
+    m, _ = lhs.shape
+    f_dim = wg.shape[2]
+    nm, nj, nc = m // tm, f_dim // tj, lhs.shape[1] // tc
+    row_spec = pl.BlockSpec((tm, tj), lambda i, j, c, te: (i, j))
+    out_specs = [row_spec] + ([row_spec, row_spec] if save_pre else [])
+    out_shape = [out_sds((m, f_dim), lhs.dtype, tile_expert, lhs, wg)]
+    if save_pre:
+        out_shape += [out_sds((m, f_dim), lhs.dtype, tile_expert, lhs,
+                              wg)] * 2
+    outs = pl.pallas_call(
+        functools.partial(_gmm_glu_kernel, nc=nc, save_pre=save_pre),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nm, nj, nc),
+            in_specs=[
+                pl.BlockSpec((tm, tc), lambda i, j, c, te: (i, c)),
+                pl.BlockSpec((1, tc, tj), lambda i, j, c, te: (te[i], c, j)),
+                pl.BlockSpec((1, tc, tj), lambda i, j, c, te: (te[i], c, j)),
+            ],
+            out_specs=out_specs,
+            scratch_shapes=[pltpu.VMEM((tm, tj), jnp.float32),
+                            pltpu.VMEM((tm, tj), jnp.float32)],
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(tile_expert.astype(jnp.int32), lhs, wg, wu)
+    # pallas_call returns a list when out_shape is a list (even len 1)
+    return tuple(outs) if isinstance(outs, (list, tuple)) else (outs,)
+
+
+def _glu_cfg(tm, k, n):
+    """Tile choice for the two-weight kernel, or None when no safe
+    tiling exists: both weight blocks live in VMEM together, so the K
+    block halves vs the single-weight gmm (two [tc, tj] bf16 blocks
+    double-buffered + two f32 accumulators must stay under the ~16M
+    scoped budget).  _pick_tile's full-dim fallback can exceed the cap
+    (e.g. K=1408 has no >=128 divisor <= 512) — those shapes keep the
+    two-gmm path."""
+    tk = _pick_tile(k, 512)
+    tn = _pick_tile(n, 1024)
+    if tk > 512 or tn > 1408:
+        return None
+    return (tm, tk, tn)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def glu_grouped(lhs, wg, wu, tile_expert, counts, cfg):
+    """Fused silu(lhs @ wg[e]) * (lhs @ wu[e]) over the sorted
+    tile-aligned layout.  ``cfg`` = (tm, tk, tn, interpret)."""
+    tm, tk, tn, interp = cfg
+    (hs,) = _gmm_glu_call(lhs, wg, wu, tile_expert, tm=tm, tc=tk,
+                          tj=tn, save_pre=False, interpret=interp)
+    return hs
+
+
+def _glu_grouped_fwd(lhs, wg, wu, tile_expert, counts, cfg):
+    tm, tk, tn, interp = cfg
+    hs, hg, hu = _gmm_glu_call(lhs, wg, wu, tile_expert, tm=tm, tc=tk,
+                               tj=tn, save_pre=True, interpret=interp)
+    return hs, (lhs, wg, wu, tile_expert, counts, hg, hu)
+
+
+def _glu_grouped_bwd(cfg, res, dhs):
+    lhs, wg, wu, tile_expert, counts, hg, hu = res
+    tm, tk, tn, interp = cfg
+    g = hg.astype(jnp.float32)
+    sg = jax.nn.sigmoid(g)
+    silu_g = g * sg
+    dhs_f = dhs.astype(jnp.float32)
+    dhg = (dhs_f * hu.astype(jnp.float32)
+           * (sg * (1 + g * (1 - sg)))).astype(lhs.dtype)
+    dhu = (dhs_f * silu_g).astype(lhs.dtype)
+    # dX via the transposed gmm for each branch; dW via the dw kernel
+    dlhs = _gmm_call(dhg, wg, tile_expert, transpose_w=True, tm=tm,
+                     tc=tn, tj=tk, interpret=interp)
+    dlhs = dlhs + _gmm_call(dhu, wu, tile_expert, transpose_w=True,
+                            tm=tm, tc=tn, tj=tk, interpret=interp)
+    e = wg.shape[0]
+    dwg = _gmm_dw_call(lhs, dhg, tile_expert, counts, e, tm=tm, tk=tk,
+                       tn=tn, interpret=interp)
+    dwu = _gmm_dw_call(lhs, dhu, tile_expert, counts, e, tm=tm, tk=tk,
+                       tn=tn, interpret=interp)
+    return (dlhs.astype(lhs.dtype), dwg.astype(wg.dtype),
+            dwu.astype(wu.dtype), None, None)
+
+
+glu_grouped.defvjp(_glu_grouped_fwd, _glu_grouped_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -208,9 +342,13 @@ def gmm(lhs, w, tile_expert, counts, *, tm=512, interpret=False):
     Measured on v5e (36864×1024 @ 8×1024×704, bf16): tm=512 with the
     full K as one block beats tm=256/tk=512 by ~1.5× and beats XLA's
     dense batched einsum by ~1.36× (26.9 vs 19.8 TFLOP/s in a
-    serialized scan microbench)."""
+    serialized scan microbench).  Small row tiles free VMEM for a
+    full-K block (r5 sweep at the 64-expert shape: tm=256/tk=2048 hit
+    140 TF/s vs tm=384/tk=1024's 121; tk=2048 at tm>=384 overflows
+    VMEM)."""
     k, n = w.shape[1], w.shape[2]
-    cfg = (tm, _pick_tile(k, 1024), _pick_tile(n, 1024), interpret)
+    kcap = 2048 if tm <= 256 else 1024
+    cfg = (tm, _pick_tile(k, kcap), _pick_tile(n, 1024), interpret)
     return grouped_matmul(lhs, w, tile_expert, counts, cfg)
 
 
@@ -275,15 +413,30 @@ def _auto_tm(e: int, n_rows: int) -> int:
     """Measured (v5e, round 4) row-tile table.  Big tiles win until
     per-expert padding dominates: at 8 experts (qwen2 shape, F=704)
     tm=512 with full-K blocks is best (26.9 TF/s, 1.36x XLA's dense
-    einsum); at 64 experts (DeepSeekMoE shape, H=2048, F=1408) tm=384
-    beats 256/512 (9.19 vs 9.40/10.37 ms marginal per layer) and the
-    round-3 heuristic's tm=128 was 1.39x SLOWER than the dense
-    comparator.  Tiny buffers fall back so the padding bound stays
+    einsum); at 64 experts (DeepSeekMoE shape, H=2048, F=1408) the r5 sweep
+    moved the pick to tm=256 (whose smaller tile frees VMEM for a
+    full-K=2048 block: 140 TF/s vs tm=384/tk=1024's 121 and tm=512's
+    80); the round-3 heuristic's tm=128 was 1.39x SLOWER than the
+    dense comparator.  Tiny buffers fall back so the padding bound stays
     sane."""
-    tm = 512 if e <= 16 else 384
+    tm = 512 if e <= 16 else 256
     while tm > 128 and e * tm > n_rows:
         tm //= 2
     return max(tm, 128)
+
+
+def _gate_up(xs, wg, wu, tile_expert, counts, *, tm, interpret, act):
+    """silu-GLU goes through the fused two-dot kernel (one lhs stream,
+    epilogue in VMEM); any other activation keeps the two-gmm path."""
+    cfg = _glu_cfg(tm, wg.shape[1], wg.shape[2]) \
+        if act is jax.nn.silu else None
+    if cfg is not None:
+        return glu_grouped(xs, wg, wu, tile_expert, counts,
+                           cfg + (interpret,))
+    hg = gmm(xs, wg, tile_expert, counts, tm=tm, interpret=interpret)
+    hu = gmm(xs, wu, tile_expert, counts, tm=tm, interpret=interpret)
+    return (act(hg.astype(jnp.float32)) *
+            hu.astype(jnp.float32)).astype(xs.dtype)
 
 
 def dropless_moe_ffn_rows(x_rows, row_expert, wg, wu, wd, *, tm=None,
@@ -303,10 +456,8 @@ def dropless_moe_ffn_rows(x_rows, row_expert, wg, wu, wd, *, tm=None,
     xs = jnp.zeros((m_pad, h), x_rows.dtype).at[dest].set(
         x_rows[order], mode="drop")
 
-    hg = gmm(xs, wg, tile_expert, counts, tm=tm, interpret=interpret)
-    hu = gmm(xs, wu, tile_expert, counts, tm=tm, interpret=interpret)
-    hs = (act(hg.astype(jnp.float32)) *
-          hu.astype(jnp.float32)).astype(x_rows.dtype)
+    hs = _gate_up(xs, wg, wu, tile_expert, counts, tm=tm,
+                  interpret=interpret, act=act)
     ys = gmm(hs, wd, tile_expert, counts, tm=tm, interpret=interpret)
 
     dest_safe = jnp.minimum(dest, m_pad - 1)
@@ -334,10 +485,8 @@ def dropless_moe_ffn(x, gate_vals, expert_idx, wg, wu, wd, *, tm=None,
     rows = x[order // k]                                   # [T*k, H]
     xs = jnp.zeros((m_pad, h), x.dtype).at[dest].set(rows)
 
-    hg = gmm(xs, wg, tile_expert, counts, tm=tm, interpret=interpret)
-    hu = gmm(xs, wu, tile_expert, counts, tm=tm, interpret=interpret)
-    hs = (act(hg.astype(jnp.float32)) *
-          hu.astype(jnp.float32)).astype(x.dtype)
+    hs = _gate_up(xs, wg, wu, tile_expert, counts, tm=tm,
+                  interpret=interpret, act=act)
     ys = gmm(hs, wd, tile_expert, counts, tm=tm, interpret=interpret)
 
     y_slots = ys[dest]                                     # [T*k, H] sorted
