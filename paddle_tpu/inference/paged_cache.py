@@ -79,6 +79,11 @@ class PagedKVCache:
         self._lens[slot] = 0
         self._table[slot, :] = 0
 
+    def set_len(self, slot: int, n: int):
+        """Host-side length after an in-graph prefill wrote the pages
+        directly (chunked prefill)."""
+        self._lens[slot] = n
+
     def advance(self, slots, n: int = 1):
         for s in np.atleast_1d(slots):
             self._lens[s] += n
