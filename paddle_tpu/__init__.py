@@ -44,6 +44,7 @@ from . import sparse
 from . import audio
 from . import fft
 from . import distribution
+from . import geometric
 from . import linalg
 from . import regularizer
 from . import signal

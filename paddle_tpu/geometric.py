@@ -1,0 +1,108 @@
+"""paddle.geometric — graph message-passing ops.
+
+Reference parity: python/paddle/geometric (send_u_recv / send_ue_recv /
+send_uv message passing, segment reductions) over phi graph kernels.
+
+TPU-native design: every op is a gather along edge indices + an XLA
+scatter-reduce (``jax.ops.segment_*``) — the exact lowering GNN
+libraries use on TPU, where sorted-segment reductions beat the
+reference's atomics-based CUDA scatter kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.api import tensorize
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+
+def _seg_reduce(data, ids, pool_type, num):
+    ids = ids.astype(jnp.int32)
+    if pool_type == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=num)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, data.dtype), ids,
+                                  num_segments=num)
+        shape = (num,) + (1,) * (data.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1.0)
+    if pool_type == "max":
+        out = jax.ops.segment_max(data, ids, num_segments=num)
+        # paddle fills untouched rows with 0, not -inf
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if pool_type == "min":
+        out = jax.ops.segment_min(data, ids, num_segments=num)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def _message(xs, ys, message_op):
+    if message_op == "add":
+        return xs + ys
+    if message_op == "sub":
+        return xs - ys
+    if message_op == "mul":
+        return xs * ys
+    if message_op == "div":
+        return xs / ys
+    raise ValueError(f"unknown message_op {message_op}")
+
+
+def _send_u_recv_raw(x, src_index, dst_index, reduce_op="sum",
+                     out_size=None):
+    """Gather x at src edges, reduce into dst nodes."""
+    num = int(out_size) if out_size is not None else x.shape[0]
+    return _seg_reduce(x[src_index], dst_index, reduce_op, num)
+
+
+def _send_ue_recv_raw(x, y, src_index, dst_index, message_op="add",
+                      reduce_op="sum", out_size=None):
+    """Combine node features x[src] with edge features y, reduce to dst."""
+    num = int(out_size) if out_size is not None else x.shape[0]
+    xs = x[src_index]
+    ys = y
+    if ys.ndim < xs.ndim:
+        ys = ys.reshape(ys.shape + (1,) * (xs.ndim - ys.ndim))
+    return _seg_reduce(_message(xs, ys, message_op), dst_index,
+                       reduce_op, num)
+
+
+def _send_uv_raw(x, y, src_index, dst_index, message_op="add"):
+    """Per-edge message from both endpoints (no reduction)."""
+    return _message(x[src_index], y[dst_index], message_op)
+
+
+def _segment_sum_raw(data, segment_ids):
+    n = int(jax.device_get(segment_ids).max()) + 1 \
+        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
+    return _seg_reduce(data, segment_ids, "sum", n)
+
+
+def _segment_mean_raw(data, segment_ids):
+    n = int(jax.device_get(segment_ids).max()) + 1 \
+        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
+    return _seg_reduce(data, segment_ids, "mean", n)
+
+
+def _segment_max_raw(data, segment_ids):
+    n = int(jax.device_get(segment_ids).max()) + 1 \
+        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
+    return _seg_reduce(data, segment_ids, "max", n)
+
+
+def _segment_min_raw(data, segment_ids):
+    n = int(jax.device_get(segment_ids).max()) + 1 \
+        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
+    return _seg_reduce(data, segment_ids, "min", n)
+
+
+send_u_recv = tensorize(_send_u_recv_raw)
+send_ue_recv = tensorize(_send_ue_recv_raw)
+send_uv = tensorize(_send_uv_raw)
+segment_sum = tensorize(_segment_sum_raw)
+segment_mean = tensorize(_segment_mean_raw)
+segment_max = tensorize(_segment_max_raw)
+segment_min = tensorize(_segment_min_raw)

@@ -4,16 +4,20 @@ Implementations live in ops/_linalg.py (XLA lowerings; decompositions
 run on the TPU's QR/eig units where available, CPU callback otherwise).
 """
 from .ops.api import (  # noqa: F401
-    cholesky, cholesky_solve, cond, corrcoef, cov, det, eigh, eigvalsh,
-    inv, lstsq, lu, matrix_norm, matrix_power, matrix_rank, norm, pinv,
-    qr, slogdet, solve, svd, triangular_solve, vector_norm,
+    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh,
+    eigvals, eigvalsh, householder_product, inv, lstsq, lu, lu_unpack,
+    matrix_exp, matrix_norm, matrix_power, matrix_rank, matrix_transpose,
+    norm, ormqr, pca_lowrank, pinv, qr, slogdet, solve, svd, svd_lowrank,
+    svdvals, triangular_solve, vector_norm,
 )
 
 __all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov",
-           "det", "eigh", "eigvalsh", "inv", "lstsq", "lu",
-           "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
-           "norm", "pinv", "qr", "slogdet", "solve", "svd",
-           "triangular_solve", "vector_norm"]
+           "det", "eig", "eigh", "eigvals", "eigvalsh",
+           "householder_product", "inv", "lstsq", "lu", "lu_unpack",
+           "matrix_exp", "matrix_norm", "matrix_power", "matrix_rank",
+           "matrix_transpose", "multi_dot", "norm", "ormqr",
+           "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
+           "svd_lowrank", "svdvals", "triangular_solve", "vector_norm"]
 
 
 def multi_dot(tensors):

@@ -34,6 +34,10 @@ from ..ops.api import (  # noqa: F401
     temporal_shift, rrelu, max_pool1d, avg_pool1d, adaptive_avg_pool1d,
     adaptive_max_pool1d, adaptive_avg_pool3d, adaptive_max_pool3d,
     lp_pool1d, lp_pool2d, max_unpool2d, embedding_bag,
+    sequence_mask, dice_loss, npair_loss, multi_margin_loss,
+    softmax_with_cross_entropy, feature_alpha_dropout, max_unpool1d,
+    max_unpool3d, class_center_sample, margin_cross_entropy,
+    adaptive_log_softmax_with_loss,
 )
 from ..ops import api as _api
 from ..tensor import apply_op

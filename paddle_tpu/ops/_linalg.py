@@ -175,3 +175,148 @@ def pdist(x, p=2.0):
 
 def vander(x, n=None, increasing=False):
     return jnp.vander(x, N=n, increasing=increasing)
+
+
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def matrix_exp(x):
+    import jax.scipy.linalg as jsl
+    if x.ndim == 2:
+        return jsl.expm(x)
+    batch = x.shape[:-2]
+    flat = x.reshape((-1,) + x.shape[-2:])
+    out = jax.vmap(jsl.expm)(flat)
+    return out.reshape(batch + x.shape[-2:])
+
+
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def eig(x):
+    """paddle.linalg.eig: general (non-symmetric) eigendecomposition.
+
+    TPU/XLA has no nonsymmetric-eig unit; the reference routes this to
+    LAPACK geev on host too, so a host callback loses nothing — the op
+    is O(n^3) scalar-sequential and tiny next to any training step.
+    """
+    cdt = jnp.complex64 if x.dtype in (jnp.float32, jnp.complex64) \
+        else jnp.complex128
+
+    def host(a):
+        w, v = np.linalg.eig(np.asarray(a))
+        return w.astype(cdt), v.astype(cdt)
+
+    if isinstance(x, jax.core.Tracer):
+        # under jit: host callback (CPU backend only — the axon PJRT
+        # plugin has no send/recv callbacks, and neither TPU generation
+        # has a nonsymmetric-eig unit; eager mode below covers TPU)
+        out_shape = (jax.ShapeDtypeStruct(x.shape[:-1], cdt),
+                     jax.ShapeDtypeStruct(x.shape, cdt))
+        return jax.pure_callback(host, out_shape, x,
+                                 vmap_method="sequential")
+    w, v = host(jax.device_get(x))
+    try:
+        return jnp.asarray(w), jnp.asarray(v)
+    except Exception:
+        # axon rejects multi-dim complex transfers; the reference's eig
+        # result is CPU-resident anyway, so place ours there too
+        cpu = jax.devices("cpu")[0]
+        return jax.device_put(w, cpu), jax.device_put(v, cpu)
+
+
+def eigvals(x):
+    return eig(x)[0]
+
+
+def householder_product(x, tau):
+    """paddle.linalg.householder_product: assemble Q from the reflectors
+    LAPACK-packed in ``x`` (below-diagonal) and scales ``tau`` (orgqr).
+    The reflector count is static, so the loop unrolls into k rank-1
+    updates — each a matmul XLA fuses; no LAPACK needed on device."""
+    if x.ndim > 2:
+        return jax.vmap(householder_product)(x, tau)
+    m, n = x.shape
+    k = tau.shape[-1]
+    rows = jnp.arange(m)
+    q = jnp.eye(m, n, dtype=x.dtype)
+    conj = jnp.conj if jnp.iscomplexobj(x) else (lambda a: a)
+    for i in reversed(range(k)):
+        v = jnp.where(rows == i, 1.0, jnp.where(rows > i, x[:, i], 0.0))
+        q = q - tau[i] * jnp.outer(v, conj(v) @ q)
+    return q
+
+
+def ormqr(x, tau, y, left=True, transpose=False):
+    """paddle.linalg.ormqr: multiply ``y`` by the Q of (x, tau)."""
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    if x.ndim > 2:
+        return jax.vmap(lambda a, t, b: ormqr(a, t, b, left, transpose))(
+            x, tau, y)
+    # build the FULL m x m Q (householder_product's m x n panel is not
+    # enough to multiply arbitrary y): same reflector loop over I_m
+    rows = jnp.arange(m)
+    qf = jnp.eye(m, dtype=x.dtype)
+    conj = jnp.conj if jnp.iscomplexobj(x) else (lambda a: a)
+    for i in reversed(range(k)):
+        v = jnp.where(rows == i, 1.0, jnp.where(rows > i, x[:, i], 0.0))
+        qf = qf - tau[i] * jnp.outer(v, conj(v) @ qf)
+    qm = jnp.swapaxes(conj(qf), -1, -2) if transpose else qf
+    return qm @ y if left else y @ qm
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """paddle.linalg.lu_unpack: (P, L, U) from packed LU + 1-based
+    sequential transposition pivots."""
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    if lu_data.ndim > 2:
+        return jax.vmap(
+            lambda d, p: lu_unpack(d, p, unpack_ludata, unpack_pivots))(
+                lu_data, lu_pivots)
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_data[:, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+        U = jnp.triu(lu_data[:k, :])
+    if unpack_pivots:
+        perm = jnp.arange(m)
+        for i in range(lu_pivots.shape[-1]):
+            j = lu_pivots[i] - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        # rows of P: P[perm[i], i] = 1 reverses the row swaps
+        P = jnp.zeros((m, m), lu_data.dtype).at[perm, jnp.arange(m)].set(1.0)
+    return P, L, U
+
+
+def _lowrank_svd(x, q, niter, M=None):
+    """Randomized range-finder SVD (Halko et al.) — q+oversample matmuls
+    only, all MXU; deterministic seed (paddle's is seed-dependent too)."""
+    a = x - M if M is not None else x
+    m, n = a.shape[-2], a.shape[-1]
+    p = min(q + 6, n)
+    g = jax.random.normal(jax.random.PRNGKey(0), a.shape[:-2] + (n, p),
+                          dtype=a.dtype)
+    y = a @ g
+    for _ in range(niter):
+        y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(Q, -1, -2) @ a
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    u = Q @ u
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]
+
+
+def svd_lowrank(x, q=6, niter=2, M=None):
+    return _lowrank_svd(x, q, niter, M=M)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+    M = jnp.mean(x, axis=-2, keepdims=True) if center else None
+    return _lowrank_svd(x, q, niter, M=jnp.broadcast_to(M, x.shape)
+                        if M is not None else None)

@@ -704,3 +704,31 @@ def msort(x):
 def histogram_bin_edges(input, bins=100, min=0, max=0):
     r = None if (min == 0 and max == 0) else (min, max)
     return jnp.histogram_bin_edges(input, bins=bins, range=r)
+
+
+def addcdiv(input, tensor1, tensor2, value=1.0):
+    return input + value * tensor1 / tensor2
+
+
+def addcmul(input, tensor1, tensor2, value=1.0):
+    return input + value * tensor1 * tensor2
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def vecdot(x, y, axis=-1):
+    return jnp.sum(jnp.conj(x) * y, axis=axis)
+
+
+def reduce_as(x, target):
+    """paddle.reduce_as: sum x down to target's shape (grad-reduction
+    semantics for broadcasting)."""
+    xs, ts = list(x.shape), list(target.shape)
+    lead = len(xs) - len(ts)
+    axes = tuple(range(lead)) + tuple(
+        i + lead for i, (a, b) in enumerate(zip(xs[lead:], ts))
+        if a != b and b == 1)
+    out = jnp.sum(x, axis=axes, keepdims=True) if axes else x
+    return out.reshape(ts)

@@ -528,14 +528,38 @@ def max_pool2d(x, kernel_size, stride=None, padding=0,
 @_channel_last_aware
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
-    if return_mask:
-        raise NotImplementedError(
-            "max_pool3d(return_mask=True) is not supported; the 2D "
-            "pool/unpool pairing is (max_pool2d, max_unpool2d)")
     n = 3
     k = _norm_tuple(kernel_size, n)
     s = _norm_tuple(stride if stride is not None else kernel_size, n)
     p = _conv_padding(padding, n, s, (1, 1, 1), k)
+    if return_mask:
+        # same contract as max_pool2d: non-overlapping unpadded windows
+        # only (the pool/unpool pairing); mask = flat DHW argmax index
+        if (list(s) != list(k) or isinstance(p, str)
+                or any(a or b for a, b in p)):
+            raise NotImplementedError(
+                "max_pool3d(return_mask=True) supports stride == "
+                "kernel_size with no padding")
+        if ceil_mode and any(x.shape[2 + i] % k[i] for i in range(3)):
+            raise NotImplementedError(
+                "max_pool3d(return_mask=True, ceil_mode=True) with a "
+                "partial trailing window is not supported")
+        nb, c, d, h, w = x.shape
+        od, oh, ow = d // k[0], h // k[1], w // k[2]
+        win = x[:, :, :od * k[0], :oh * k[1], :ow * k[2]].reshape(
+            nb, c, od, k[0], oh, k[1], ow, k[2])
+        win = jnp.transpose(win, (0, 1, 2, 4, 6, 3, 5, 7)).reshape(
+            nb, c, od, oh, ow, k[0] * k[1] * k[2])
+        out = jnp.max(win, axis=-1)
+        flat = jnp.argmax(win, axis=-1)
+        wd = flat // (k[1] * k[2])
+        wh = (flat // k[2]) % k[1]
+        ww = flat % k[2]
+        ds = jnp.arange(od)[None, None, :, None, None] * k[0] + wd
+        hs = jnp.arange(oh)[None, None, None, :, None] * k[1] + wh
+        ws = jnp.arange(ow)[None, None, None, None, :] * k[2] + ww
+        mask = ((ds * h + hs) * w + ws).astype(jnp.int32)
+        return out, mask
     if ceil_mode and not isinstance(p, str):
         p = _ceil_mode_pads(x.shape[2:2 + n], k, s, p)
     pads = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
@@ -960,7 +984,7 @@ def alpha_dropout(x, p=0.5, training=True):
         return x
     alpha_p = -1.7580993408473766
     keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, x.shape)
-    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
     b = -a * p * alpha_p
     return a * jnp.where(keep, x, alpha_p) + b
 
@@ -1308,7 +1332,8 @@ def _adaptive_pool3d(x, output_size, reduce_fn):
     planes = jnp.stack(outs, axis=2)   # [N, C, od, H, W]
     n, c, od_, h, w = planes.shape
     flat = planes.reshape(n, c * od_, h, w)
-    pooled = _adaptive_pool2d(flat, (oh, ow), reduce_fn)
+    pooled = _adaptive_pool2d(flat, (oh, ow),
+                              lambda s: reduce_fn(s, axis=(2, 3)))
     return pooled.reshape(n, c, od_, oh, ow)
 
 
@@ -1378,3 +1403,197 @@ def embedding_bag(input, weight, offsets=None, mode="mean"):
     if mode == "max":
         return jnp.max(emb, axis=1)
     return jnp.mean(emb, axis=1)
+
+
+# -- round-5 long-tail batch (VERDICT r4 #10) --------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """paddle.nn.functional.sequence_mask: [..., maxlen] with 1 where
+    position < length."""
+    import numpy as _np
+    if maxlen is None:
+        maxlen = int(_np.asarray(jax.device_get(x)).max())
+    pos = jnp.arange(maxlen)
+    return (pos < x[..., None]).astype(dtype)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Dice loss over the last (class-prob) axis; label holds class ids
+    [..., 1] (paddle F.dice_loss contract)."""
+    nclass = input.shape[-1]
+    oh = jax.nn.one_hot(label.squeeze(-1), nclass, dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * oh, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + jnp.sum(oh, axis=reduce_axes)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (Sohn 2016): softmax CE over anchor@positive.T with
+    same-label targets, + L2 on the embeddings."""
+    labels = labels.reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    xent = jnp.mean(jnp.sum(
+        tgt * (jax.nn.logsumexp(sim, axis=1, keepdims=True) - sim), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive,
+                                       axis=1))) * 0.25
+    return xent + reg
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    """paddle F.multi_margin_loss: hinge loss against every wrong class."""
+    n, c = input.shape
+    tgt = jnp.take_along_axis(input, label[:, None].astype(jnp.int32), 1)
+    m = jnp.maximum(0.0, margin - tgt + input) ** p
+    if weight is not None:
+        m = m * weight[label][:, None]
+    mask = 1.0 - jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(m * mask, axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    """Legacy fused op (paddle F.softmax_with_cross_entropy): returns
+    UNREDUCED per-row loss with a trailing 1-dim, like the reference."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        squeeze_back = False
+        if lbl.ndim == logits.ndim:
+            lbl = lbl.squeeze(axis)
+            squeeze_back = True
+        safe = jnp.where(lbl == ignore_index, 0, lbl).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis)
+        loss = jnp.where(jnp.expand_dims(lbl == ignore_index, axis),
+                         0.0, -picked)
+        if not squeeze_back:
+            pass  # paddle keeps the trailing dim either way
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def feature_alpha_dropout(x, p=0.5, training=True):
+    """alpha_dropout dropping whole feature maps (channel axis 1)."""
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    shape = tuple(x.shape[i] if i < 2 else 1 for i in range(x.ndim))
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, shape)
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * p * alpha_p
+    return a * jnp.where(jnp.broadcast_to(keep, x.shape), x, alpha_p) + b
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+    """1-D unpool through the 2-D path (single-row plane: the flat
+    index is identical)."""
+    out2d = max_unpool2d(
+        x[:, :, None, :], indices[:, :, None, :],
+        (1, _norm_tuple(kernel_size, 1)[0]),
+        (1, _norm_tuple(stride if stride is not None else kernel_size,
+                        1)[0]),
+        (0, _norm_tuple(padding, 1)[0]),
+        output_size=(1, output_size[-1]) if output_size else None)
+    return out2d[:, :, 0, :]
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+    """Scatter pooled values back to argmax positions in a DHW volume."""
+    k = _norm_tuple(kernel_size, 3)
+    s = _norm_tuple(stride if stride is not None else kernel_size, 3)
+    p = _norm_tuple(padding, 3)
+    n, c, d, h, w = x.shape
+    if output_size is None:
+        od = (d - 1) * s[0] + k[0] - 2 * p[0]
+        oh = (h - 1) * s[1] + k[1] - 2 * p[1]
+        ow = (w - 1) * s[2] + k[2] - 2 * p[2]
+    else:
+        od, oh, ow = output_size[-3], output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, od * oh * ow), x.dtype)
+    idx = indices.reshape(n, c, d * h * w).astype(jnp.int32)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        idx].set(x.reshape(n, c, d * h * w))
+    return flat.reshape(n, c, od, oh, ow)
+
+
+def class_center_sample(label, num_classes, num_samples):
+    """paddle F.class_center_sample: keep every positive class center
+    plus fill to num_samples with other classes; labels remapped into
+    the sampled set.  Deterministic fill (ascending unsampled ids) —
+    the reference samples uniformly; any fill set is a valid sample and
+    determinism keeps the op jit-cacheable."""
+    pos = jnp.zeros((num_classes,), jnp.bool_).at[label].set(True)
+    # order: positives first (stable), then the rest; take num_samples
+    order = jnp.argsort(~pos, stable=True)
+    sampled = jax.lax.dynamic_slice_in_dim(order, 0, num_samples)
+    # remap: position of each class id within `sampled`, -1 if absent
+    inv = jnp.full((num_classes,), -1, jnp.int32).at[sampled].set(
+        jnp.arange(num_samples, dtype=jnp.int32))
+    return inv[label], sampled
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean"):
+    """Combined-margin softmax CE (ArcFace family): the target-class
+    cosine becomes cos(m1*theta + m2) - m3 before scaling.  logits must
+    be cosines (normalized embeddings x normalized weights)."""
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    modified = jnp.cos(margin1 * theta + margin2) - margin3
+    oh = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    out = scale * (oh * modified + (1.0 - oh) * cos)
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = -jnp.sum(oh * logp, axis=-1, keepdims=True)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(out, axis=-1)
+    return loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight,
+                                   tail_weights, cutoffs,
+                                   head_bias=None):
+    """Adaptive softmax (Grave et al.): frequent classes in the head,
+    rare ones in down-projected tail clusters.  Returns (output, loss)
+    = (per-row target log-prob, its mean NLL), paddle's contract.
+
+    TPU note: every row computes every cluster (masked), so the op is
+    static-shaped and jit-safe — the host-side gather/scatter the
+    reference uses per cluster would break under tracing here."""
+    n_clusters = len(cutoffs)                  # tail clusters
+    head_size = cutoffs[0] + n_clusters
+    head = input @ head_weight
+    if head_bias is not None:
+        head = head + head_bias
+    head_logp = jax.nn.log_softmax(head, axis=-1)
+    lbl = label.astype(jnp.int32)
+    # head part: classes < cutoffs[0]
+    in_head = lbl < cutoffs[0]
+    safe_head = jnp.where(in_head, lbl, 0)
+    out = jnp.take_along_axis(head_logp, safe_head[:, None], 1)[:, 0]
+    out = jnp.where(in_head, out, 0.0)
+    for i, (proj, w) in enumerate(tail_weights):
+        lo = cutoffs[i]
+        hi = cutoffs[i + 1] if i + 1 < len(cutoffs) else lo + w.shape[-1]
+        in_c = (lbl >= lo) & (lbl < hi)
+        tail_logp = jax.nn.log_softmax(input @ proj @ w, axis=-1)
+        safe = jnp.where(in_c, lbl - lo, 0)
+        cluster_logit_pos = cutoffs[0] + i     # head slot of cluster i
+        lp = (head_logp[:, cluster_logit_pos]
+              + jnp.take_along_axis(tail_logp, safe[:, None], 1)[:, 0])
+        out = jnp.where(in_c, lp, out)
+    return out, -jnp.mean(out)

@@ -146,12 +146,54 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     return apply_op(raw, input)
 
 
+def is_complex(x):
+    import jax.numpy as jnp
+    return jnp.issubdtype(getattr(x, "value", x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    import jax.numpy as jnp
+    return jnp.issubdtype(getattr(x, "value", x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    import jax.numpy as jnp
+    return jnp.issubdtype(getattr(x, "value", x).dtype, jnp.integer)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+# paddle-surface aliases over existing ops
+_NS["clamp"] = _NS["clip"]
+_NS["true_divide"] = _NS["divide"]
+_NS["bitwise_invert"] = _NS["bitwise_not"]
+globals().update({"clamp": _NS["clamp"], "true_divide": _NS["true_divide"],
+                  "bitwise_invert": _NS["bitwise_invert"]})
+__all__ += ["clamp", "true_divide", "bitwise_invert"]
+
 for _n in ("is_tensor", "rank", "numel", "is_empty", "clone",
-           "broadcast_shape", "shard_index"):
+           "broadcast_shape", "shard_index", "is_complex",
+           "is_floating_point", "is_integer", "set_printoptions"):
     _NS[_n] = globals()[_n]
     if _n not in __all__:
         __all__.append(_n)
-for _n in ("rank", "numel", "is_empty", "clone"):
+for _n in ("rank", "numel", "is_empty", "clone", "is_complex",
+           "is_floating_point", "is_integer"):
     TENSOR_METHODS[_n] = _NS[_n]
 
 

@@ -173,3 +173,116 @@ def transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
     idx = x._bcoo.indices[:, jnp.asarray(list(perm))]
     shape = tuple(x.shape[p] for p in perm)
     return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx), shape=shape))
+
+
+# -- value-map unary surface (paddle.sparse.{sin,tanh,sqrt,...}) ------------
+# Each maps f over stored values only (paddle's semantics: these ops all
+# satisfy f(0)=0, so sparsity is preserved exactly).
+
+def _value_map(fn):
+    from jax.experimental import sparse as jsparse
+
+    def op(x: SparseCooTensor) -> SparseCooTensor:
+        return SparseCooTensor(jsparse.BCOO(
+            (fn(x._bcoo.data), x._bcoo.indices), shape=x.shape))
+    return op
+
+
+def _install_unary():
+    import jax.numpy as jnp
+    table = {
+        "sin": jnp.sin, "sinh": jnp.sinh, "asin": jnp.arcsin,
+        "asinh": jnp.arcsinh, "tan": jnp.tan, "tanh": jnp.tanh,
+        "atan": jnp.arctan, "atanh": jnp.arctanh, "sqrt": jnp.sqrt,
+        "square": jnp.square, "abs": jnp.abs, "neg": jnp.negative,
+        "expm1": jnp.expm1, "log1p": jnp.log1p, "sign": jnp.sign,
+        "relu6": lambda v: jnp.clip(v, 0, 6),
+        "leaky_relu": lambda v: jnp.where(v > 0, v, 0.01 * v),
+    }
+    for name, fn in table.items():
+        globals()[name] = _value_map(fn)
+        __all__.append(name)
+
+
+_install_unary()
+
+
+def pow(x: SparseCooTensor, factor) -> SparseCooTensor:  # noqa: A001
+    return _value_map(lambda v: v ** factor)(x)
+
+
+def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None):
+    from jax.experimental import sparse as jsparse
+    from .common.dtype import convert_dtype
+    data = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(convert_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=x.shape))
+
+
+def subtract(x, y):
+    return add(x, multiply(y, to_tensor(-1.0))
+               if isinstance(y, SparseCooTensor) else Tensor(-_unwrap(y)))
+
+
+def divide(x: SparseCooTensor, y):
+    """sparse / dense (evaluated at stored positions)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+    d = _unwrap(y)
+    if isinstance(d, jsparse.BCOO):
+        d = d.todense()
+    idx = x._bcoo.indices
+    div = d[tuple(idx[:, i] for i in range(idx.shape[1]))] \
+        if jnp.ndim(d) else d
+    return SparseCooTensor(jsparse.BCOO(
+        (x._bcoo.data / div, idx), shape=x.shape))
+
+
+def mv(x: SparseCooTensor, vec) -> Tensor:
+    return Tensor(x._bcoo @ _unwrap(vec))
+
+
+def sum(x: SparseCooTensor, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    import jax.numpy as jnp
+    out = jnp.sum(x._bcoo.todense(), axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from .common.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return SparseCooTensor(x._bcoo.sum_duplicates(nse=x._bcoo.nse))
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def softmax(x: SparseCooTensor, axis=-1) -> SparseCooTensor:
+    """Row-wise softmax over STORED entries (paddle sparse.softmax: the
+    implicit zeros are excluded, 2D COO, last axis)."""
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+    enforce(len(x.shape) == 2,
+            "sparse softmax supports 2-D COO tensors")
+    enforce(axis in (-1, len(x.shape) - 1),
+            "sparse softmax supports the last axis")
+    xc = x._bcoo.sum_duplicates(nse=x._bcoo.nse)
+    rows = xc.indices[:, 0].astype(jnp.int32)
+    n = x.shape[0]
+    import jax as _jax
+    rmax = _jax.ops.segment_max(xc.data, rows, num_segments=n)
+    rmax = jnp.where(jnp.isfinite(rmax), rmax, 0.0)
+    ex = jnp.exp(xc.data - rmax[rows])
+    rsum = _jax.ops.segment_sum(ex, rows, num_segments=n)
+    return SparseCooTensor(jsparse.BCOO(
+        (ex / rsum[rows], xc.indices), shape=x.shape))
+
+
+__all__ += ["pow", "cast", "subtract", "divide", "mv", "sum", "coalesce",
+            "is_same_shape", "softmax"]

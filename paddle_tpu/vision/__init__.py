@@ -1,8 +1,8 @@
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 from .datasets import FakeData
 from .models import (BasicBlock, BottleneckBlock, LeNet, ResNet, VGG,
                      resnet18, resnet34, resnet50, resnet101, vgg16)
 
-__all__ = ["datasets", "models", "transforms", "FakeData", "LeNet",
+__all__ = ["datasets", "models", "ops", "transforms", "FakeData", "LeNet",
            "ResNet", "VGG", "BasicBlock", "BottleneckBlock", "resnet18",
            "resnet34", "resnet50", "resnet101", "vgg16"]

@@ -1,0 +1,656 @@
+"""paddle.vision.ops: detection/vision operators.
+
+Reference parity: python/paddle/vision/ops.py (roi_align, roi_pool,
+psroi_pool, nms, deform_conv2d, yolo_box, prior_box, box_coder,
+matrix_nms, distribute_fpn_proposals, generate_proposals + the layer
+wrappers RoIAlign/RoIPool/DeformConv2D).
+
+TPU design notes:
+- The pooling/sampling ops are fully vectorized gathers + reductions —
+  no per-roi loops — so XLA tiles them; roi_align's sampling grid is
+  static (``sampling_ratio=-1`` resolves to 2 rather than the
+  reference's per-roi adaptive count, which would make shapes
+  data-dependent and kill jit caching).
+- Greedy NMS keeps a fixed-shape in-graph core (IoU matrix + fori_loop
+  suppression mask); only the final variable-length index extraction
+  runs on host, so the op composes with jit through `_nms_keep_mask`.
+- distribute_fpn_proposals / generate_proposals return ragged,
+  data-dependent outputs by contract, so they are eager host ops (the
+  reference's are device kernels writing variable-length LoD — a shape
+  regime XLA does not have).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..nn.layer import Layer
+from ..ops.api import tensorize
+from ..tensor import to_tensor
+
+__all__ = ["roi_align", "roi_pool", "psroi_pool", "nms", "matrix_nms",
+           "box_coder", "yolo_box", "prior_box", "deform_conv2d",
+           "distribute_fpn_proposals", "generate_proposals",
+           "RoIAlign", "RoIPool", "DeformConv2D"]
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling helper (shared by roi_align / deform_conv2d)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, y, x):
+    """Sample img [..., H, W] at float coords y/x [*S] with roi_align
+    border semantics: points past [-1, dim] contribute 0, edge points
+    clamp.  img leading dims broadcast against the sample dims."""
+    H, W = img.shape[-2], img.shape[-1]
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y = jnp.clip(y, 0.0, H - 1)
+    x = jnp.clip(x, 0.0, W - 1)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = y - y0
+    wx = x - x0
+    v00 = img[..., y0, x0]
+    v01 = img[..., y0, x1]
+    v10 = img[..., y1, x0]
+    v11 = img[..., y1, x1]
+    out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+           + v10 * wy * (1 - wx) + v11 * wy * wx)
+    return out * valid.astype(img.dtype)
+
+
+def _roi_batch_index(boxes_num, num_rois):
+    """[R] image index per roi from per-image roi counts."""
+    ends = jnp.cumsum(boxes_num)
+    return jnp.sum(jnp.arange(num_rois)[:, None] >= ends[None, :],
+                   axis=1).astype(jnp.int32)
+
+
+def _roi_align_raw(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+                   sampling_ratio=-1, aligned=True):
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    sr = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+    R = boxes.shape[0]
+    bi = _roi_batch_index(boxes_num, R)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / ow
+    bin_h = roi_h / oh
+    # sample coords [R, o, sr]: start + (bin + (s+.5)/sr) * bin_size
+    gy = (y1[:, None, None]
+          + (jnp.arange(oh)[None, :, None]
+             + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
+          * bin_h[:, None, None])                       # [R, oh, sr]
+    gx = (x1[:, None, None]
+          + (jnp.arange(ow)[None, :, None]
+             + (jnp.arange(sr)[None, None, :] + 0.5) / sr)
+          * bin_w[:, None, None])                       # [R, ow, sr]
+    yy = gy[:, :, :, None, None]                        # [R, oh, sr, 1, 1]
+    xx = gx[:, None, None, :, :]                        # [R, 1, 1, ow, sr]
+    imgs = x[bi]                                        # [R, C, H, W]
+    yb = jnp.broadcast_to(yy, (R, oh, sr, ow, sr))
+    xb = jnp.broadcast_to(xx, (R, oh, sr, ow, sr))
+    samp = jax.vmap(_bilinear_gather)(imgs, yb, xb)     # [R, C, oh,sr,ow,sr]
+    return jnp.mean(samp, axis=(3, 5))                  # [R, C, oh, ow]
+
+
+def _roi_pool_raw(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Exact integer-bin max pool (the reference kernel's floor/ceil bin
+    walls), staged as two masked max-reductions so no [R,C,oh,ow,H,W]
+    intermediate is built."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    bi = _roi_batch_index(boxes_num, R)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1)
+
+    def walls(start, size, nbins, dim):
+        b = jnp.arange(nbins)
+        lo = start[:, None] + jnp.floor(
+            b[None, :] * size[:, None] / nbins).astype(jnp.int32)
+        hi = start[:, None] + jnp.ceil(
+            (b[None, :] + 1) * size[:, None] / nbins).astype(jnp.int32)
+        lo = jnp.clip(lo, 0, dim)
+        hi = jnp.clip(hi, 0, dim)
+        pos = jnp.arange(dim)
+        mask = (pos[None, None, :] >= lo[:, :, None]) \
+            & (pos[None, None, :] < hi[:, :, None])
+        return mask                                    # [R, nbins, dim]
+
+    hmask = walls(y1, roi_h, oh, H)
+    wmask = walls(x1, roi_w, ow, W)
+    imgs = x[bi]                                       # [R, C, H, W]
+    neg = jnp.finfo(x.dtype).min
+    rows = jnp.max(jnp.where(wmask[:, None, None, :, :],
+                             imgs[:, :, :, None, :], neg),
+                   axis=-1)                            # [R, C, H, ow]
+    out = jnp.max(jnp.where(hmask[:, None, :, None, :],
+                            jnp.moveaxis(rows, 2, 3)[:, :, None, :, :],
+                            neg), axis=-1)             # [R, C, oh, ow]
+    empty = (~jnp.any(hmask, -1))[:, None, :, None] \
+        | (~jnp.any(wmask, -1))[:, None, None, :]
+    return jnp.where(empty, 0.0, out)
+
+
+def _psroi_pool_raw(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI average pool: input C = out_c*oh*ow, bin
+    (i, j) of output channel k averages input channel k*oh*ow + i*ow + j."""
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    N, C, H, W = x.shape
+    out_c = C // (oh * ow)
+    R = boxes.shape[0]
+    bi = _roi_batch_index(boxes_num, R)
+    x1 = boxes[:, 0] * spatial_scale
+    y1 = boxes[:, 1] * spatial_scale
+    roi_w = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0.1) * spatial_scale
+    roi_h = jnp.maximum(boxes[:, 3] - boxes[:, 1], 0.1) * spatial_scale
+
+    def walls(start, size, nbins, dim):
+        b = jnp.arange(nbins)
+        lo = jnp.floor(start[:, None]
+                       + b[None, :] * size[:, None] / nbins).astype(jnp.int32)
+        hi = jnp.ceil(start[:, None] + (b[None, :] + 1)
+                      * size[:, None] / nbins).astype(jnp.int32)
+        lo = jnp.clip(lo, 0, dim)
+        hi = jnp.clip(hi, 0, dim)
+        pos = jnp.arange(dim)
+        mask = (pos[None, None, :] >= lo[:, :, None]) \
+            & (pos[None, None, :] < hi[:, :, None])
+        return mask
+
+    hmask = walls(y1, roi_h, oh, H).astype(x.dtype)     # [R, oh, H]
+    wmask = walls(x1, roi_w, ow, W).astype(x.dtype)     # [R, ow, W]
+    imgs = x[bi].reshape(R, out_c, oh, ow, H, W)
+    # sum over the bin window, psroi channel select by construction
+    s = jnp.einsum("rkijhw,rih,rjw->rkij", imgs, hmask, wmask)
+    cnt = jnp.einsum("rih,rjw->rij", hmask, wmask)[:, None]
+    return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) \
+        * jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def _nms_keep_mask(boxes, iou_threshold):
+    """In-graph greedy NMS over boxes already sorted by score desc:
+    returns the keep mask (fixed shape — jit-safe core)."""
+    n = boxes.shape[0]
+    iou = _iou_matrix(boxes)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & (idx > i) & keep[i]
+        return keep & ~sup
+
+    return lax.fori_loop(0, n, body, jnp.ones((n,), jnp.bool_))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """paddle.vision.ops.nms: kept indices, score-descending.  The
+    suppression core is in-graph; the ragged index extraction is host."""
+    b = jnp.asarray(getattr(boxes, "value", boxes), jnp.float32)
+    n = b.shape[0]
+    if scores is not None:
+        s = jnp.asarray(getattr(scores, "value", scores), jnp.float32)
+        order = jnp.argsort(-s)
+    else:
+        order = jnp.arange(n)
+    sorted_b = b[order]
+    if category_idxs is not None:
+        # category-disjoint NMS via the coordinate-offset trick: shift
+        # each category to its own disjoint plane so cross-category
+        # IoU is exactly 0
+        c = jnp.asarray(getattr(category_idxs, "value", category_idxs))
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        sorted_b = sorted_b + (c[order].astype(jnp.float32)
+                               * span)[:, None]
+    keep = _nms_keep_mask(sorted_b, iou_threshold)
+    kept = np.asarray(jax.device_get(order))[
+        np.asarray(jax.device_get(keep))]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return to_tensor(kept.astype(np.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True):
+    """Matrix NMS (SOLOv2): fully parallel decay — no sequential
+    suppression — which is why it is the TPU-preferred NMS.  bboxes
+    [N, M, 4], scores [N, C, M]; returns [K, 6] rows (label, score,
+    x1, y1, x2, y2) like the reference."""
+    bb = jnp.asarray(getattr(bboxes, "value", bboxes), jnp.float32)
+    sc = jnp.asarray(getattr(scores, "value", scores), jnp.float32)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for img in range(N):
+        s = sc[img]                                     # [C, M]
+        cls_id = jnp.arange(C)[:, None] * jnp.ones((1, M), jnp.int32)
+        flat_s = s.reshape(-1)
+        flat_box = jnp.tile(bb[img], (C, 1))            # [C*M, 4]
+        flat_cls = cls_id.reshape(-1)
+        flat_idx = jnp.tile(jnp.arange(M), (C,))
+        ok = flat_s > score_threshold
+        if background_label >= 0:
+            ok = ok & (flat_cls != background_label)
+        # top nms_top_k among valid, score-desc (fixed shape k)
+        k = min(nms_top_k, flat_s.shape[0])
+        masked_s = jnp.where(ok, flat_s, -jnp.inf)
+        top_s, top_i = lax.top_k(masked_s, k)
+        box_k = flat_box[top_i]
+        cls_k = flat_cls[top_i]
+        iou = _iou_matrix(box_k)
+        same = (cls_k[:, None] == cls_k[None, :])
+        ii = jnp.arange(k)
+        valid = same & (ii[:, None] < ii[None, :])       # i suppressor of j
+        # comp[i] = how much i was itself overlapped by higher boxes
+        comp = jnp.max(jnp.where(valid, iou, 0.0), axis=0)
+        if use_gaussian:
+            dec = jnp.exp(-(iou ** 2 - comp[:, None] ** 2)
+                          / gaussian_sigma)
+        else:
+            dec = (1 - iou) / jnp.maximum(1 - comp[:, None], 1e-10)
+        decay = jnp.min(jnp.where(valid, dec, 1.0), axis=0)
+        new_s = top_s * decay
+        keep = jnp.isfinite(top_s) & (new_s > post_threshold)
+        keep_np = np.asarray(jax.device_get(keep))
+        order = np.argsort(-np.asarray(jax.device_get(new_s)))
+        order = order[keep_np[order]][:keep_top_k]
+        rows = np.concatenate([
+            np.asarray(jax.device_get(cls_k))[order, None].astype(
+                np.float32),
+            np.asarray(jax.device_get(new_s))[order, None],
+            np.asarray(jax.device_get(box_k))[order]], axis=1)
+        outs.append(rows)
+        idxs.append(np.asarray(jax.device_get(flat_idx[top_i]))[order]
+                    + img * M)
+        nums.append(len(order))
+    out = to_tensor(np.concatenate(outs, 0) if outs
+                    else np.zeros((0, 6), np.float32))
+    res = [out]
+    if return_index:
+        res.append(to_tensor(np.concatenate(idxs).astype(np.int64)))
+    if return_rois_num:
+        res.append(to_tensor(np.asarray(nums, np.int32)))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+# ---------------------------------------------------------------------------
+# box coding / decoding
+# ---------------------------------------------------------------------------
+
+def _box_coder_raw(prior_box, prior_box_var, target_box,
+                   code_type="encode_center_size", box_normalized=True,
+                   axis=0):
+    norm = 1.0 if box_normalized else 0.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + (1 - norm)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (1 - norm)
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,), target_box.dtype)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, target_box.dtype)
+    else:
+        var = prior_box_var
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + (1 - norm)
+        th = target_box[:, 3] - target_box[:, 1] + (1 - norm)
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        # [T, P] pairwise encode (reference contract)
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if var.ndim == 1:
+            out = out / var
+        else:
+            out = out / var[None, :, :]
+        return out
+    # decode_center_size: target_box [P, 4] or [N, P, 4] deltas
+    t = target_box if target_box.ndim == 3 else target_box[None]
+    if axis == 1:
+        pcx_, pcy_, pw_, ph_ = (v[None, None] for v in (pcx, pcy, pw, ph))
+    else:
+        pcx_, pcy_, pw_, ph_ = (v[None, :] for v in (pcx, pcy, pw, ph))
+    v = var if var.ndim > 1 else var[None, None, :]
+    cx = v[..., 0] * t[..., 0] * pw_ + pcx_
+    cy = v[..., 1] * t[..., 1] * ph_ + pcy_
+    w = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+    h = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - (1 - norm), cy + h * 0.5 - (1 - norm)],
+                    axis=-1)
+    return out if target_box.ndim == 3 else out[0]
+
+
+def _yolo_box_raw(x, img_size, anchors, class_num, conf_thresh,
+                  downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+                  iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head [N, na*(5+cls), H, W] into boxes + scores."""
+    N, _, H, W = x.shape
+    na = len(anchors) // 2
+    a = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :na].reshape(N, na, 1, H, W))
+        x = x[:, na:]
+    p = x.reshape(N, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sx = scale_x_y
+    bx = (jax.nn.sigmoid(p[:, :, 0]) * sx - 0.5 * (sx - 1) + gx) / W
+    by = (jax.nn.sigmoid(p[:, :, 1]) * sx - 0.5 * (sx - 1) + gy) / H
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+    bw = jnp.exp(p[:, :, 2]) * a[None, :, 0, None, None] / input_w
+    bh = jnp.exp(p[:, :, 3]) * a[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(p[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) \
+            * ious[:, :, 0] ** iou_aware_factor
+    probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+    conf_mask = (conf >= conf_thresh).astype(x.dtype)
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * imw
+    y1 = (by - bh * 0.5) * imh
+    x2 = (bx + bw * 0.5) * imw
+    y2 = (by + bh * 0.5) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
+    boxes = boxes.transpose(0, 1, 3, 2, 4).reshape(N, na * H * W, 4)
+    scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+    scores = scores.reshape(N, na * H * W, class_num)
+    return boxes, scores
+
+
+def _prior_box_raw(input, image, min_sizes, max_sizes=None,
+                   aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+                   flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+                   min_max_aspect_ratios_order=False):
+    """SSD prior boxes: [H, W, P, 4] boxes + matching variances."""
+    H, W = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes_per = []
+    for k, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            boxes_per.append((ms, ms))
+            if max_sizes:
+                d = float(np.sqrt(ms * float(max_sizes[k])))
+                boxes_per.append((d, d))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes_per.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                boxes_per.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                d = float(np.sqrt(ms * float(max_sizes[k])))
+                boxes_per.append((d, d))
+    P = len(boxes_per)
+    wh = jnp.asarray(boxes_per, jnp.float32)            # [P, 2] (w, h)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg = cx[None, :, None]
+    cyg = cy[:, None, None]
+    bw = wh[None, None, :, 0] * 0.5
+    bh = wh[None, None, :, 1] * 0.5
+    out = jnp.stack(jnp.broadcast_arrays(
+        (cxg - bw) / img_w, (cyg - bh) / img_h,
+        (cxg + bw) / img_w, (cyg + bh) / img_h), axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (H, W, P, 4))
+    return out, var
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+def _deform_conv2d_raw(x, offset, weight, bias=None, stride=1, padding=0,
+                       dilation=1, deformable_groups=1, groups=1,
+                       mask=None):
+    """DCN v1/v2: bilinear-sample every kernel tap at its offset
+    position, then contract with the weights — one im2col-sized gather
+    + one MXU matmul (the reference's fused CUDA kernel, XLA-style).
+    offset [N, 2*dg*kh*kw, oh, ow], (dy, dx) interleaved per tap."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    N, C, H, W = x.shape
+    OC, Cg, kh, kw = weight.shape
+    kk = kh * kw
+    dg = deformable_groups
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    off = offset.reshape(N, dg, kk, 2, oh, ow)
+    base_y = (jnp.arange(oh) * sh - ph)[None, :, None]
+    base_x = (jnp.arange(ow) * sw - pw)[None, None, :]
+    ky = (jnp.arange(kk) // kw * dh)[:, None, None]
+    kx = (jnp.arange(kk) % kw * dw)[:, None, None]
+    py = base_y + ky                                   # [kk, oh, ow]
+    px = base_x + kx
+    sy = py[None, None] + off[:, :, :, 0]              # [N, dg, kk, oh, ow]
+    sx = px[None, None] + off[:, :, :, 1]
+    cpg = C // dg                                      # channels per dg
+    xg = x.reshape(N, dg, cpg, H, W)
+    # vmap over batch and deformable group: sample [cpg, kk, oh, ow]
+    samp = jax.vmap(jax.vmap(
+        lambda img, yy, xx: _bilinear_gather(
+            img[:, None], yy[None], xx[None])))(xg, sy, sx)
+    # [N, dg, cpg, kk, oh, ow] -> [N, C, kk, oh, ow]
+    samp = samp.reshape(N, C, kk, oh, ow)
+    if mask is not None:                               # DCNv2 modulation
+        m = jnp.asarray(getattr(mask, "value", mask))  # kwarg: may be Tensor
+        m = m.reshape(N, dg, kk, oh, ow)
+        m = jnp.repeat(m, cpg, axis=1).reshape(N, C, kk, oh, ow)
+        samp = samp * m
+    cg = C // groups
+    samp = samp.reshape(N, groups, cg, kk, oh, ow)
+    wg = weight.reshape(groups, OC // groups, Cg, kk)
+    out = jnp.einsum("ngckij,gock->ngoij", samp, wg)
+    out = out.reshape(N, OC, oh, ow)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# FPN / proposal ops (ragged outputs -> eager host ops by contract)
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None):
+    rois = np.asarray(jax.device_get(getattr(fpn_rois, "value", fpn_rois)))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, out_nums, order = [], [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(to_tensor(rois[idx].astype(np.float32)))
+        out_nums.append(len(idx))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    res_num = [to_tensor(np.asarray([n], np.int32)) for n in out_nums] \
+        if rois_num is not None else None
+    restore_t = to_tensor(restore.astype(np.int64)[:, None])
+    if rois_num is not None:
+        return outs, restore_t, res_num
+    return outs, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False):
+    """RPN proposal generation: decode deltas on anchors, clip, filter
+    small, NMS — composed from the in-graph box decode + NMS core."""
+    N = scores.shape[0]
+    sc = jnp.asarray(getattr(scores, "value", scores))
+    bd = jnp.asarray(getattr(bbox_deltas, "value", bbox_deltas))
+    an = jnp.asarray(getattr(anchors, "value", anchors)).reshape(-1, 4)
+    va = jnp.asarray(getattr(variances, "value", variances)).reshape(-1, 4)
+    ims = jnp.asarray(getattr(img_size, "value", img_size))
+    rois, roi_probs, roi_nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for i in range(N):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].transpose(1, 2, 0).reshape(-1, 4)
+        k = min(pre_nms_top_n, s.shape[0])
+        top_s, top_i = lax.top_k(s, k)
+        a = an[top_i]
+        v = va[top_i]
+        dd = d[top_i]
+        # decode (variance-scaled center-size, the RPN convention)
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * dd[:, 0] * aw + acx
+        cy = v[:, 1] * dd[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(v[:, 2] * dd[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(v[:, 3] * dd[:, 3], 10.0)) * ah
+        prop = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+        imh, imw = ims[i, 0], ims[i, 1]
+        prop = jnp.stack([jnp.clip(prop[:, 0], 0, imw - off),
+                          jnp.clip(prop[:, 1], 0, imh - off),
+                          jnp.clip(prop[:, 2], 0, imw - off),
+                          jnp.clip(prop[:, 3], 0, imh - off)], -1)
+        keep_sz = ((prop[:, 2] - prop[:, 0] + off >= min_size)
+                   & (prop[:, 3] - prop[:, 1] + off >= min_size))
+        sk = jnp.where(keep_sz, top_s, -jnp.inf)
+        keep = _nms_keep_mask(prop, nms_thresh) & keep_sz
+        keep_np = np.asarray(jax.device_get(keep))
+        prop_np = np.asarray(jax.device_get(prop))[keep_np]
+        s_np = np.asarray(jax.device_get(sk))[keep_np]
+        ordr = np.argsort(-s_np)[:post_nms_top_n]
+        rois.append(prop_np[ordr])
+        roi_probs.append(s_np[ordr])
+        roi_nums.append(len(ordr))
+    rois_t = to_tensor(np.concatenate(rois, 0).astype(np.float32))
+    probs_t = to_tensor(np.concatenate(roi_probs, 0).astype(
+        np.float32)[:, None])
+    if return_rois_num:
+        return rois_t, probs_t, to_tensor(np.asarray(roi_nums, np.int32))
+    return rois_t, probs_t
+
+
+# tensorized public entries (tape-dispatched like every other op)
+roi_align = tensorize(_roi_align_raw)
+roi_pool = tensorize(_roi_pool_raw)
+psroi_pool = tensorize(_psroi_pool_raw)
+box_coder = tensorize(_box_coder_raw)
+yolo_box = tensorize(_yolo_box_raw)
+prior_box = tensorize(_prior_box_raw)
+deform_conv2d = tensorize(_deform_conv2d_raw)
+
+
+# ---------------------------------------------------------------------------
+# layer wrappers
+# ---------------------------------------------------------------------------
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from .. import nn
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *k], attr=weight_attr,
+            default_initializer=nn.initializer.KaimingNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)

@@ -3,7 +3,7 @@
 1. a PaddleNLP-style recipe script (model build → finetune loop with
    clip + scheduler + amp → generate → save/load) runs end-to-end;
 2. a sweep that EXECUTES the public op surface with synthesized
-   arguments — ≥450 distinct public callables must run without
+   arguments — ≥550 distinct public callables must run without
    NotImplementedError.
 """
 import inspect
@@ -87,8 +87,11 @@ def _mk():
           "complex64")
     SPD = t(np.eye(4) * 2.0 + 0.1)
     IMG = t(rng.standard_normal((2, 3, 8, 8)))
-    return dict(M=M, V=V, P=P, I=I, B=B, C=C, SPD=SPD, IMG=IMG, t=t,
-                rng=rng)
+    SP = paddle.sparse.sparse_coo_tensor(
+        t([[0, 1, 2], [1, 0, 3]], "int64"),
+        t([0.5, 0.25, 0.75]), [4, 4])
+    return dict(M=M, V=V, P=P, I=I, B=B, C=C, SPD=SPD, IMG=IMG, SP=SP,
+                t=t, rng=rng)
 
 
 def _special_cases(e):
@@ -489,6 +492,118 @@ def _special_cases(e):
             M, M, paddle.sparse.sparse_coo_tensor(
                 t([[0, 1], [1, 0]], "int64"), t([1.0, 2.0]), [4, 4]))
         if hasattr(paddle.sparse, "masked_matmul") else None,
+        # round-5 long-tail batch (VERDICT r4 #10)
+        "sequence_mask": lambda: F.sequence_mask(i4, maxlen=5),
+        "dice_loss": lambda: F.dice_loss(
+            F.softmax(M), t(rng.integers(0, 4, (4, 1)), "int64")),
+        "npair_loss": lambda: F.npair_loss(M, M, lab4),
+        "multi_margin_loss": lambda: F.multi_margin_loss(M, lab4),
+        "softmax_with_cross_entropy":
+            lambda: F.softmax_with_cross_entropy(M, lab4),
+        "class_center_sample":
+            lambda: F.class_center_sample(lab4, 8, 4),
+        "margin_cross_entropy": lambda: F.margin_cross_entropy(P, lab4),
+        "adaptive_log_softmax_with_loss":
+            lambda: F.adaptive_log_softmax_with_loss(
+                M, t(rng.integers(0, 4, (4,)), "int64"),
+                t(rng.standard_normal((4, 3))),
+                [(t(rng.standard_normal((4, 2))),
+                  t(rng.standard_normal((2, 2))))], [2]),
+        "max_unpool1d": lambda: F.max_unpool1d(
+            *F.max_pool1d(t(rng.standard_normal((2, 3, 8))), 2,
+                          return_mask=True), 2),
+        "max_unpool3d": lambda: F.max_unpool3d(
+            *F.max_pool3d(t(rng.standard_normal((1, 2, 4, 4, 4))), 2,
+                          return_mask=True), 2),
+        "addcdiv": lambda: paddle.addcdiv(M, M, SPD),
+        "addcmul": lambda: paddle.addcmul(M, M, M),
+        "set_printoptions": lambda: paddle.set_printoptions(precision=8),
+        "householder_product": lambda: paddle.linalg.householder_product(
+            M, t(_np.zeros(2))),
+        "ormqr": lambda: paddle.linalg.ormqr(M, t(_np.zeros(2)), M),
+        "lu_unpack": lambda: paddle.linalg.lu_unpack(
+            *paddle.linalg.lu(SPD)),
+        # vision.ops detection family
+        "roi_align": lambda: paddle.vision.ops.roi_align(
+            IMG, t([[1, 1, 6, 6], [0, 0, 4, 4]]),
+            t([1, 1], "int32"), 2),
+        "roi_pool": lambda: paddle.vision.ops.roi_pool(
+            IMG, t([[1, 1, 6, 6], [0, 0, 4, 4]]),
+            t([1, 1], "int32"), 2),
+        "psroi_pool": lambda: paddle.vision.ops.psroi_pool(
+            t(rng.standard_normal((1, 8, 6, 6))),
+            t([[0, 0, 5, 5]]), t([1], "int32"), 2),
+        "nms": lambda: paddle.vision.ops.nms(
+            t([[0, 0, 5, 5], [1, 1, 6, 6], [20, 20, 30, 30]]), 0.4,
+            t([0.9, 0.8, 0.7])),
+        "matrix_nms": lambda: paddle.vision.ops.matrix_nms(
+            t(rng.uniform(0, 20, (1, 5, 4))),
+            t(rng.uniform(0, 1, (1, 2, 5))), 0.1),
+        "box_coder": lambda: paddle.vision.ops.box_coder(
+            t([[10, 10, 30, 40]]), [0.1, 0.1, 0.2, 0.2],
+            t([[12, 11, 28, 35]])),
+        "yolo_box": lambda: paddle.vision.ops.yolo_box(
+            t(rng.standard_normal((1, 21, 2, 2))),
+            t([[64, 64]], "int32"), [10, 13, 16, 30, 33, 23], 2,
+            0.01, 32),
+        "prior_box": lambda: paddle.vision.ops.prior_box(
+            IMG, t(rng.standard_normal((2, 3, 32, 32))), [8.0], [16.0],
+            [2.0]),
+        "deform_conv2d": lambda: paddle.vision.ops.deform_conv2d(
+            IMG, t(_np.zeros((2, 18, 6, 6))),
+            t(rng.standard_normal((4, 3, 3, 3)))),
+        "distribute_fpn_proposals":
+            lambda: paddle.vision.ops.distribute_fpn_proposals(
+                t([[0, 0, 10, 10], [0, 0, 200, 200]]), 2, 5, 4, 224),
+        "generate_proposals":
+            lambda: paddle.vision.ops.generate_proposals(
+                t(rng.uniform(0, 1, (1, 3, 2, 2))),
+                t(rng.standard_normal((1, 12, 2, 2)) * 0.1),
+                t([[64, 64]]),
+                t(rng.uniform(0, 40, (12, 4)) + _np.array([0, 0, 20, 20])),
+                t(_np.tile([0.1, 0.1, 0.2, 0.2], (12, 1))),
+                pre_nms_top_n=8, post_nms_top_n=4),
+        # sparse surface (prefixed keys: namespace-specific impls)
+        "sparse.pow": lambda: paddle.sparse.pow(e["SP"], 2),
+        "sparse.mv": lambda: paddle.sparse.mv(
+            e["SP"], t(rng.standard_normal((4,)))),
+        "sparse.matmul": lambda: paddle.sparse.matmul(e["SP"], M),
+        "sparse.masked_matmul": lambda: paddle.sparse.masked_matmul(
+            M, M, e["SP"]),
+        "sparse.transpose": lambda: paddle.sparse.transpose(
+            e["SP"], [1, 0]),
+        "sparse.is_same_shape": lambda: paddle.sparse.is_same_shape(
+            e["SP"], e["SP"]),
+        "sparse.cast": lambda: paddle.sparse.cast(
+            e["SP"], value_dtype="float32"),
+        # geometric message passing
+        "send_u_recv": lambda: paddle.geometric.send_u_recv(
+            M, i4[:3], i4[:3]),
+        "send_ue_recv": lambda: paddle.geometric.send_ue_recv(
+            M, t(rng.standard_normal((3, 4))), i4[:3], i4[:3]),
+        "send_uv": lambda: paddle.geometric.send_uv(
+            M, M, i4[:3], i4[:3]),
+        "segment_sum": lambda: paddle.geometric.segment_sum(
+            M, t([0, 0, 1, 1], "int64")),
+        "segment_mean": lambda: paddle.geometric.segment_mean(
+            M, t([0, 0, 1, 1], "int64")),
+        "segment_max": lambda: paddle.geometric.segment_max(
+            M, t([0, 0, 1, 1], "int64")),
+        "segment_min": lambda: paddle.geometric.segment_min(
+            M, t([0, 0, 1, 1], "int64")),
+        # audio.functional
+        "get_window": lambda: paddle.audio.functional.get_window(
+            "hann", 16),
+        "hz_to_mel": lambda: paddle.audio.functional.hz_to_mel(440.0),
+        "mel_to_hz": lambda: paddle.audio.functional.mel_to_hz(20.0),
+        "compute_fbank_matrix":
+            lambda: paddle.audio.functional.compute_fbank_matrix(
+                16000, 64, 8),
+        "power_to_db": lambda: paddle.audio.functional.power_to_db(P),
+        # sweep fixes (round 5)
+        "set_grad_enabled": lambda: paddle.set_grad_enabled(True),
+        "setitem": lambda: paddle.setitem(paddle.clone(M), V[:4], 0),
+        "unfold": lambda: paddle.nn.functional.unfold(IMG, 3),
         # non-op utility callables picked up by dir() — call trivially
         "apply_op": lambda: None,
         "get_flag": lambda: None,
@@ -501,7 +616,7 @@ def _special_cases(e):
     }
 
 
-def test_op_surface_sweep_450():
+def test_op_surface_sweep_550():
     e = _mk()
     special = _special_cases(e)
     M, V, P, I = e["M"], e["V"], e["P"], e["I"]
@@ -509,9 +624,13 @@ def test_op_surface_sweep_450():
     namespaces = [("", paddle), ("nn.functional.", paddle.nn.functional),
                   ("linalg.", paddle.linalg), ("fft.", paddle.fft),
                   ("signal.", getattr(paddle, "signal", None)),
-                  ("sparse.", paddle.sparse)]
+                  ("sparse.", paddle.sparse),
+                  ("vision.ops.", paddle.vision.ops),
+                  ("geometric.", paddle.geometric),
+                  ("audio.functional.", paddle.audio.functional)]
     ran, not_run, broken = [], [], []
     seen = set()
+    SP = e["SP"]
     for prefix, mod in namespaces:
         if mod is None:
             continue
@@ -521,19 +640,29 @@ def test_op_surface_sweep_450():
             fn = getattr(mod, name)
             if not callable(fn) or inspect.isclass(fn):
                 continue
-            if name in seen:
+            # dedup by object identity: re-exports of the SAME function
+            # under several namespaces count once; a namespace's own
+            # implementation of a shared name (sparse.sin vs paddle.sin)
+            # is a distinct op and counts
+            fid = id(getattr(fn, "__func__", fn))
+            if fid in seen:
                 continue
-            seen.add(name)
+            seen.add(fid)
             attempts = []
-            if name in special:
+            if (prefix + name) in special:
+                attempts = [special[prefix + name]]
+            elif name in special:
                 attempts = [special[name]]
             else:
                 # generic synthesis: most ops are unary/binary on a
-                # square float matrix; SPD for linalg; complex for fft
+                # square float matrix; SPD for linalg; complex for fft;
+                # a sparse sample for sparse.*
                 if prefix == "linalg.":
                     args = [e["SPD"]]
                 elif prefix == "fft.":
                     args = [e["C"]]
+                elif prefix == "sparse.":
+                    args = [SP]
                 else:
                     args = [M]
                 attempts = [lambda f=fn, a=args: f(*a),
@@ -542,6 +671,10 @@ def test_op_surface_sweep_450():
                             lambda f=fn: f(I),
                             lambda f=fn: f(e["B"]),
                             lambda f=fn: f(e["IMG"])]
+                if prefix == "sparse.":
+                    attempts = [lambda f=fn: f(SP),
+                                lambda f=fn: f(SP, SP),
+                                lambda f=fn: f(SP, M)] + attempts
             ok = False
             for a in attempts:
                 try:
@@ -560,5 +693,5 @@ def test_op_surface_sweep_450():
                 not_run.append(prefix + name)
 
     assert not broken, f"ops raised NotImplementedError: {broken}"
-    assert len(ran) >= 450, (
+    assert len(ran) >= 550, (
         f"only {len(ran)} public ops executed; unrunnable: {not_run}")

@@ -1,0 +1,507 @@
+"""Value-oracle tests for the round-5 long-tail ops (VERDICT r4 #10):
+vision.ops detection family, geometric message passing, linalg tail,
+nn.functional additions.  Oracles: torch (losses/pools/adaptive
+softmax), scipy (expm/orgqr), numpy double-loop re-implementations
+(roi_align/roi_pool/nms), and algebraic identities (deform_conv2d with
+zero offsets == conv2d; decode(encode) == identity)."""
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+t = paddle.to_tensor
+rng = np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# vision.ops
+# ---------------------------------------------------------------------------
+
+def _np_roi_align(x, boxes, bi, out, scale, sr, aligned):
+    R = len(boxes)
+    N, C, H, W = x.shape
+    res = np.zeros((R, C, out, out), np.float32)
+
+    def bil(img, y, xx):
+        if y < -1 or y > H or xx < -1 or xx > W:
+            return 0.0
+        y = min(max(y, 0.0), H - 1)
+        xx = min(max(xx, 0.0), W - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        wy, wx = y - y0, xx - x0
+        return (img[y0, x0] * (1 - wy) * (1 - wx)
+                + img[y0, x1] * (1 - wy) * wx
+                + img[y1, x0] * wy * (1 - wx)
+                + img[y1, x1] * wy * wx)
+
+    off = 0.5 if aligned else 0.0
+    for r in range(R):
+        x1, y1, x2, y2 = boxes[r] * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / out, rh / out
+        for c in range(C):
+            img = x[bi[r], c]
+            for i in range(out):
+                for j in range(out):
+                    acc = 0.0
+                    for si in range(sr):
+                        for sj in range(sr):
+                            yy = y1 + (i + (si + 0.5) / sr) * bh
+                            xx = x1 + (j + (sj + 0.5) / sr) * bw
+                            acc += bil(img, yy, xx)
+                    res[r, c, i, j] = acc / (sr * sr)
+    return res
+
+
+@pytest.mark.parametrize("aligned", [True, False])
+def test_roi_align_vs_numpy_oracle(aligned):
+    x = rng.standard_normal((2, 3, 10, 10)).astype(np.float32)
+    boxes = np.array([[1, 1, 7, 8], [0, 0, 5, 5], [2.5, 1.5, 9, 6]],
+                     np.float32)
+    bnum = np.array([2, 1], np.int32)
+    ours = V.roi_align(t(x), t(boxes), t(bnum), 3, 0.5, 2,
+                       aligned).numpy()
+    ref = _np_roi_align(x, boxes, [0, 0, 1], 3, 0.5, 2, aligned)
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-5)
+
+
+def test_roi_pool_vs_numpy_oracle():
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    boxes = np.array([[0, 0, 6, 6], [2, 2, 7, 5]], np.float32)
+    ours = V.roi_pool(t(x), t(boxes), t(np.array([2], np.int32)),
+                      2, 1.0).numpy()
+    # reference bin walls: floor/ceil of i*size/bins over rounded rois
+    ref = np.zeros((2, 2, 2, 2), np.float32)
+    for r, (x1, y1, x2, y2) in enumerate(np.round(boxes).astype(int)):
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    hs = y1 + int(np.floor(i * rh / 2))
+                    he = y1 + int(np.ceil((i + 1) * rh / 2))
+                    ws = x1 + int(np.floor(j * rw / 2))
+                    we = x1 + int(np.ceil((j + 1) * rw / 2))
+                    hs, he = np.clip([hs, he], 0, 8)
+                    ws, we = np.clip([ws, we], 0, 8)
+                    win = x[0, c, hs:he, ws:we]
+                    ref[r, c, i, j] = win.max() if win.size else 0.0
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-6)
+
+
+def test_psroi_pool_position_sensitive_select():
+    # input channel k*oh*ow + i*ow + j must feed output (k, i, j):
+    # constant-valued channels make the expectation exact
+    oh = ow = 2
+    out_c = 2
+    vals = np.arange(out_c * oh * ow, dtype=np.float32)
+    x = np.tile(vals[None, :, None, None], (1, 1, 8, 8))
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    got = V.psroi_pool(t(x), t(boxes), t(np.array([1], np.int32)),
+                       2, 1.0).numpy()
+    np.testing.assert_allclose(np.asarray(got).reshape(-1), vals,
+                               atol=1e-6)
+
+
+def test_nms_vs_numpy_greedy():
+    bx = rng.uniform(0, 50, (40, 2)).astype(np.float32)
+    boxes = np.concatenate(
+        [bx, bx + rng.uniform(5, 30, (40, 2)).astype(np.float32)], 1)
+    scores = rng.uniform(0, 1, 40).astype(np.float32)
+
+    order = np.argsort(-scores)
+    keep = []
+    o = order.copy()
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    while len(o):
+        i = o[0]
+        keep.append(i)
+        if len(o) == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[o[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[o[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[o[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[o[1:], 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / (area[i] + area[o[1:]] - inter)
+        o = o[1:][iou <= 0.4]
+    got = V.nms(t(boxes), 0.4, t(scores)).numpy()
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(keep))
+
+
+def test_nms_categories_do_not_suppress_each_other():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    # same category: second suppressed
+    got = V.nms(t(boxes), 0.3, t(scores)).numpy()
+    assert len(got) == 1
+    # different categories: both kept
+    got = V.nms(t(boxes), 0.3, t(scores),
+                category_idxs=t(np.array([0, 1]), "int64"),
+                categories=[0, 1]).numpy()
+    assert len(got) == 2
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    off = np.zeros((2, 18, 6, 6), np.float32)
+    got = V.deform_conv2d(t(x), t(off), t(w), t(b)).numpy()
+    ref = F.conv2d(t(x), t(w), t(b)).numpy()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4)
+
+
+def test_deform_conv2d_integer_offset_is_shift():
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    off[:, 1::2] = 1.0                        # dx = +1 for every tap
+    got = V.deform_conv2d(t(x), t(off), t(w)).numpy()
+    xs = np.zeros_like(x)
+    xs[:, :, :, :-1] = x[:, :, :, 1:]
+    ref = F.conv2d(t(xs), t(w)).numpy()
+    np.testing.assert_allclose(np.asarray(got)[:, :, :, :-1],
+                               np.asarray(ref)[:, :, :, :-1], atol=1e-4)
+
+
+def test_deform_conv2d_mask_modulates():
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    half = np.full((1, 9, 4, 4), 0.5, np.float32)
+    got = V.deform_conv2d(t(x), t(off), t(w), mask=t(half)).numpy()
+    ref = 0.5 * np.asarray(F.conv2d(t(x), t(w)).numpy())
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+
+def test_box_coder_roundtrip_and_yolo_prior_shapes():
+    prior = np.array([[10, 10, 30, 40], [5, 5, 20, 25]], np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    tgt = np.array([[12, 11, 28, 35], [6, 7, 22, 28]], np.float32)
+    enc = V.box_coder(t(prior), var, t(tgt)).numpy()
+    assert tuple(np.asarray(enc).shape) == (2, 2, 4)
+    diag = np.ascontiguousarray(np.asarray(enc)[np.arange(2),
+                                                np.arange(2)])
+    dec = V.box_coder(t(prior), var, t(diag),
+                      code_type="decode_center_size").numpy()
+    np.testing.assert_allclose(np.asarray(dec), tgt, atol=1e-3)
+
+    yb, ys = V.yolo_box(t(rng.standard_normal((1, 21, 2, 2))
+                          .astype(np.float32)),
+                        t(np.array([[64, 64]]), "int32"),
+                        [10, 13, 16, 30, 33, 23], 2, 0.01, 32)
+    assert tuple(yb.shape) == (1, 12, 4)
+    assert tuple(ys.shape) == (1, 12, 2)
+    assert np.asarray(yb.numpy()).max() <= 64.0
+
+    pb, pv = V.prior_box(t(np.zeros((1, 3, 4, 4), np.float32)),
+                         t(np.zeros((1, 3, 32, 32), np.float32)),
+                         [8.0], [16.0], [2.0], flip=True)
+    assert tuple(pb.shape) == (4, 4, 4, 4)
+    assert tuple(pv.shape) == (4, 4, 4, 4)
+
+
+def test_matrix_nms_decay_and_outputs():
+    # two heavily-overlapping + one distant box: overlap must decay
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10, 10],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.9, 0.85, 0.8]]], np.float32)  # one class
+    out, idx, num = V.matrix_nms(t(boxes), t(scores), 0.1, 0.0,
+                                 background_label=-1, return_index=True)
+    o = np.asarray(out.numpy())
+    assert int(num.numpy()[0]) == 3
+    # first row keeps its score; the overlapped second decays hard
+    got = {int(i): s for i, s in
+           zip(np.asarray(idx.numpy()), o[:, 1])}
+    assert abs(got[0] - 0.9) < 1e-6
+    assert got[1] < 0.3                      # decayed by ~1-iou
+    assert abs(got[2] - 0.8) < 1e-6          # untouched (far away)
+
+
+def test_distribute_fpn_and_generate_proposals():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
+                     [0, 0, 300, 300]], np.float32)
+    outs, restore = V.distribute_fpn_proposals(t(rois), 2, 5, 4, 224)
+    sizes = [int(np.asarray(o.numpy()).shape[0]) for o in outs]
+    assert sum(sizes) == 3 and sizes[0] >= 1
+    order = np.concatenate([np.asarray(o.numpy()).reshape(-1, 4)
+                            for o in outs])
+    restored = order[np.argsort(
+        np.asarray(restore.numpy()).ravel())]  # restore_index undoes it
+    # restore index maps concatenated level order back to input order
+    np.testing.assert_allclose(
+        order[np.asarray(restore.numpy()).ravel()], rois)
+
+    sc = rng.uniform(0, 1, (1, 3, 2, 2)).astype(np.float32)
+    bd = (rng.standard_normal((1, 12, 2, 2)) * 0.1).astype(np.float32)
+    anch = rng.uniform(0, 40, (12, 4)).astype(np.float32)
+    anch[:, 2:] += anch[:, :2] + 10
+    va = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (12, 1))
+    r, p, n = V.generate_proposals(
+        t(sc), t(bd), t(np.array([[64, 64]], np.float32)), t(anch),
+        t(va), pre_nms_top_n=10, post_nms_top_n=4, return_rois_num=True)
+    rn = np.asarray(r.numpy())
+    assert rn.shape[1] == 4 and rn.shape[0] == int(n.numpy()[0]) <= 4
+    assert (rn >= 0).all() and (rn <= 64).all()
+
+
+def test_roi_layers_and_deform_layer():
+    x = t(rng.standard_normal((1, 4, 8, 8)).astype(np.float32))
+    boxes = t(np.array([[0, 0, 6, 6]], np.float32))
+    bnum = t(np.array([1], np.int32))
+    assert tuple(V.RoIAlign(2, 1.0)(x, boxes, bnum).shape) == (1, 4, 2, 2)
+    assert tuple(V.RoIPool(2, 1.0)(x, boxes, bnum).shape) == (1, 4, 2, 2)
+    layer = V.DeformConv2D(4, 6, 3)
+    off = t(np.zeros((1, 18, 6, 6), np.float32))
+    out = layer(x, off)
+    assert tuple(out.shape) == (1, 6, 6, 6)
+    ref = F.conv2d(x, layer.weight, layer.bias)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# geometric
+# ---------------------------------------------------------------------------
+
+def test_geometric_message_passing():
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    src = np.array([0, 1, 2, 3], np.int64)
+    dst = np.array([1, 1, 4, 4], np.int64)
+    got = paddle.geometric.send_u_recv(t(x), t(src), t(dst)).numpy()
+    ref = np.zeros((5, 3), np.float32)
+    for s, d in zip(src, dst):
+        ref[d] += x[s]
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    ew = rng.standard_normal((4, 3)).astype(np.float32)
+    got = paddle.geometric.send_ue_recv(t(x), t(ew), t(src), t(dst),
+                                        "mul", "sum").numpy()
+    ref = np.zeros((5, 3), np.float32)
+    for e, (s, d) in enumerate(zip(src, dst)):
+        ref[d] += x[s] * ew[e]
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6)
+
+    got = paddle.geometric.send_uv(t(x), t(x), t(src), t(dst),
+                                   "add").numpy()
+    np.testing.assert_allclose(np.asarray(got), x[src] + x[dst],
+                               atol=1e-6)
+
+
+def test_geometric_segment_reductions():
+    data = rng.standard_normal((6, 2)).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 2], np.int64)
+    for op, ref in [
+            ("segment_sum", np.stack([data[:2].sum(0), data[2:5].sum(0),
+                                      data[5]])),
+            ("segment_mean", np.stack([data[:2].mean(0),
+                                       data[2:5].mean(0), data[5]])),
+            ("segment_max", np.stack([data[:2].max(0), data[2:5].max(0),
+                                      data[5]])),
+            ("segment_min", np.stack([data[:2].min(0), data[2:5].min(0),
+                                      data[5]]))]:
+        got = getattr(paddle.geometric, op)(t(data), t(ids)).numpy()
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-6,
+                                   err_msg=op)
+
+
+# ---------------------------------------------------------------------------
+# linalg tail
+# ---------------------------------------------------------------------------
+
+def test_linalg_eig_and_friends():
+    A = rng.standard_normal((5, 5)).astype(np.float32)
+    w, v = paddle.linalg.eig(t(A))
+    np.testing.assert_allclose(A @ np.asarray(v.numpy()),
+                               np.asarray(v.numpy())
+                               * np.asarray(w.numpy())[None, :],
+                               atol=1e-3)
+    wr = np.linalg.eigvals(A)
+    got = np.sort_complex(np.asarray(paddle.linalg.eigvals(t(A)).numpy()))
+    np.testing.assert_allclose(np.sort_complex(wr), got, atol=1e-3)
+
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.matrix_exp(t(A * 0.1)).numpy()),
+        sla.expm(A * 0.1), atol=1e-4)
+
+    B = rng.standard_normal((6, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.svdvals(t(B)).numpy()),
+        np.linalg.svd(B, compute_uv=False), atol=1e-4)
+
+    np.testing.assert_array_equal(
+        np.asarray(paddle.linalg.matrix_transpose(t(B)).numpy()), B.T)
+
+
+def test_linalg_householder_ormqr_lu_unpack():
+    B = rng.standard_normal((6, 4)).astype(np.float32)
+    (qrf, tau), _ = sla.qr(B, mode="raw")
+    packed = t(qrf.astype(np.float32))
+    tau_t = t(tau.astype(np.float32))
+    Q = np.asarray(paddle.linalg.householder_product(
+        packed, tau_t).numpy())
+    Qref = sla.qr(B, mode="economic")[0]
+    np.testing.assert_allclose(Q, Qref, atol=1e-4)
+
+    Y = rng.standard_normal((6, 3)).astype(np.float32)
+    got = np.asarray(paddle.linalg.ormqr(packed, tau_t, t(Y)).numpy())
+    np.testing.assert_allclose(got, sla.qr(B)[0] @ Y, atol=1e-3)
+
+    A = rng.standard_normal((5, 5)).astype(np.float32)
+    LU, piv = paddle.linalg.lu(t(A))
+    P, L, U = paddle.linalg.lu_unpack(LU, piv)
+    np.testing.assert_allclose(
+        np.asarray(P.numpy()) @ np.asarray(L.numpy())
+        @ np.asarray(U.numpy()), A, atol=1e-4)
+
+
+def test_linalg_lowrank():
+    C = (rng.standard_normal((20, 4))
+         @ rng.standard_normal((4, 15))).astype(np.float32)
+    u, s, v = paddle.linalg.svd_lowrank(t(C), q=4)
+    np.testing.assert_allclose(
+        (np.asarray(u.numpy()) * np.asarray(s.numpy())[None, :])
+        @ np.asarray(v.numpy()).T, C, atol=1e-3)
+    u, s, v = paddle.linalg.pca_lowrank(t(C), q=3)
+    assert tuple(u.shape) == (20, 3) and tuple(v.shape) == (15, 3)
+
+
+# ---------------------------------------------------------------------------
+# nn.functional additions (torch oracles)
+# ---------------------------------------------------------------------------
+
+def test_functional_losses_vs_torch():
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = np.array([1, 3, 0, 5])
+    np.testing.assert_allclose(
+        np.asarray(F.multi_margin_loss(t(x), t(y, "int64")).numpy()),
+        TF.multi_margin_loss(torch.tensor(x), torch.tensor(y)).numpy(),
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.softmax_with_cross_entropy(
+            t(x), t(y, "int64")).numpy())[:, 0],
+        TF.cross_entropy(torch.tensor(x), torch.tensor(y),
+                         reduction="none").numpy(), atol=1e-5)
+
+
+def test_adaptive_log_softmax_vs_torch():
+    torch = pytest.importorskip("torch")
+    D, C, cut = 8, 20, [5, 12]
+    torch.manual_seed(0)
+    als = torch.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=cut,
+                                              div_value=2.0)
+    xin = rng.standard_normal((6, D)).astype(np.float32)
+    yin = rng.integers(0, C, (6,))
+    tout = als(torch.tensor(xin), torch.tensor(yin))
+    tails = [(t(seq[0].weight.detach().numpy().T),
+              t(seq[1].weight.detach().numpy().T)) for seq in als.tail]
+    out, loss = F.adaptive_log_softmax_with_loss(
+        t(xin), t(yin.astype(np.int64)),
+        t(als.head.weight.detach().numpy().T), tails, cut)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               tout.output.detach().numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(loss.numpy()),
+                               tout.loss.detach().numpy(), atol=1e-4)
+
+
+def test_pool3d_masks_and_unpool_vs_torch():
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+    p3, i3 = F.max_pool3d(t(x3), 2, 2, return_mask=True)
+    tp, ti = TF.max_pool3d(torch.tensor(x3), 2, 2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(p3.numpy()), tp.numpy())
+    np.testing.assert_array_equal(np.asarray(i3.numpy()), ti.numpy())
+    np.testing.assert_allclose(
+        np.asarray(F.max_unpool3d(p3, i3, 2, 2).numpy()),
+        TF.max_unpool3d(tp, ti, 2, 2).numpy())
+
+    x1 = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    p1, i1 = F.max_pool1d(t(x1), 2, 2, return_mask=True)
+    t1, ti1 = TF.max_pool1d(torch.tensor(x1), 2, 2, return_indices=True)
+    np.testing.assert_allclose(
+        np.asarray(F.max_unpool1d(p1, i1, 2, 2).numpy()),
+        TF.max_unpool1d(t1, ti1, 2, 2).numpy())
+
+
+def test_adaptive_pool3d_vs_torch():
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    x = rng.standard_normal((1, 2, 5, 7, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_avg_pool3d(t(x), 2).numpy()),
+        TF.adaptive_avg_pool3d(torch.tensor(x), 2).numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(F.adaptive_max_pool3d(t(x), (2, 3, 2)).numpy()),
+        TF.adaptive_max_pool3d(torch.tensor(x), (2, 3, 2)).numpy(),
+        atol=1e-5)
+
+
+def test_margin_cross_entropy_and_class_center_sample():
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    cos = np.clip(rng.standard_normal((4, 6)).astype(np.float32) * 0.3,
+                  -1, 1)
+    lbl = np.array([1, 3, 0, 5])
+    # margins zeroed == plain CE over scaled cosines
+    got = F.margin_cross_entropy(t(cos), t(lbl, "int64"), margin1=1.0,
+                                 margin2=0.0, margin3=0.0,
+                                 scale=10.0).numpy()
+    ref = TF.cross_entropy(torch.tensor(cos * 10.0),
+                           torch.tensor(lbl)).numpy()
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+    # arcface margin increases the loss (target logit shrinks)
+    got_m = F.margin_cross_entropy(t(cos), t(lbl, "int64"),
+                                   scale=10.0).numpy()
+    assert float(got_m) > float(got)
+
+    remapped, sampled = F.class_center_sample(
+        t(np.array([7, 2, 7, 9]), "int64"), 12, 6)
+    s = np.asarray(sampled.numpy()).tolist()
+    r = np.asarray(remapped.numpy()).tolist()
+    assert {2, 7, 9}.issubset(set(s)) and len(s) == 6
+    assert all(s[r[i]] == v for i, v in enumerate([7, 2, 7, 9]))
+
+
+def test_alpha_dropout_preserves_moments():
+    # SELU self-normalizing contract: N(0,1) in -> ~N(0,1) out
+    paddle.seed(0)
+    x = t(rng.standard_normal((4000, 200)).astype(np.float32))
+    for fn in (F.alpha_dropout, F.feature_alpha_dropout):
+        o = np.asarray(fn(x, 0.5).numpy())
+        assert abs(o.std() - 1.0) < 0.05, fn.__name__
+        assert abs(o.mean()) < 0.05, fn.__name__
+
+
+def test_sequence_mask_and_sparse_round5():
+    m = F.sequence_mask(t(np.array([2, 4, 1]), "int64"), maxlen=5)
+    assert np.asarray(m.numpy()).tolist() == [
+        [1, 1, 0, 0, 0], [1, 1, 1, 1, 0], [1, 0, 0, 0, 0]]
+
+    SP = paddle.sparse.sparse_coo_tensor(
+        t(np.array([[0, 1, 2], [1, 0, 3]]), "int64"),
+        t(np.array([0.5, 0.25, 0.75], np.float32)), [4, 4])
+    dense = np.asarray(SP.to_dense().numpy())
+    np.testing.assert_allclose(
+        np.asarray(paddle.sparse.sin(SP).to_dense().numpy()),
+        np.sin(dense) * (dense != 0), atol=1e-6)
+    sm = np.asarray(paddle.sparse.softmax(SP).to_dense().numpy())
+    # stored entries become 1.0 per row here (single entry per row)
+    np.testing.assert_allclose(sm.sum(), 3.0, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.sparse.mv(
+            SP, t(np.ones(4, np.float32))).numpy()),
+        dense @ np.ones(4, np.float32), atol=1e-6)
+    assert paddle.sparse.is_same_shape(SP, SP)
+    np.testing.assert_allclose(
+        np.asarray(paddle.sparse.subtract(SP, SP).to_dense().numpy()),
+        np.zeros((4, 4)), atol=1e-6)
