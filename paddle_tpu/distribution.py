@@ -326,3 +326,363 @@ def _kl_cat(p: Categorical, q: Categorical):
         b = jax.nn.log_softmax(lq, axis=-1)
         return jnp.sum(jnp.exp(a) * (a - b), axis=-1)
     return apply_op(raw, p.logits, q.logits)
+
+
+# ---------------------------------------------------------------------------
+# round-5 batch: the remaining reference distribution zoo
+# ---------------------------------------------------------------------------
+
+def _t(v):
+    return to_tensor(v, dtype="float32") if not isinstance(v, Tensor) \
+        else v
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha, self.beta = _t(alpha), _t(beta)
+        super().__init__(tuple(np.broadcast_shapes(self.alpha.shape,
+                                                   self.beta.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda a, b: jax.random.beta(key, a, b, shp),
+            self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def raw(v, a, b):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - jss.betaln(a, b))
+        return apply_op(raw, value, self.alpha, self.beta)
+
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def entropy(self):
+        def raw(a, b):
+            import jax.scipy.special as jss
+            return (jss.betaln(a, b) - (a - 1) * jss.digamma(a)
+                    - (b - 1) * jss.digamma(b)
+                    + (a + b - 2) * jss.digamma(a + b))
+        return apply_op(raw, self.alpha, self.beta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration, self.rate = _t(concentration), _t(rate)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda c, r: jax.random.gamma(key, c, shp) / r,
+            self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def raw(v, c, r):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jss.gammaln(c))
+        return apply_op(raw, value, self.concentration, self.rate)
+
+    def mean(self):
+        return self.concentration / self.rate
+
+    def entropy(self):
+        def raw(c, r):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            return (c - jnp.log(r) + jss.gammaln(c)
+                    + (1 - c) * jss.digamma(c))
+        return apply_op(raw, self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _t(df)
+        super().__init__(df * 0.5, to_tensor(0.5))
+        self.df = df
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda c: jax.random.dirichlet(key, c, shp), self.concentration)
+
+    def log_prob(self, value):
+        def raw(v, c):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jss.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jss.gammaln(c), -1))
+        return apply_op(raw, value, self.concentration)
+
+    def mean(self):
+        from . import ops
+        s = ops.sum(self.concentration, axis=-1, keepdim=True)
+        return self.concentration / s
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(p):
+            import jax.numpy as jnp
+            u = jax.random.uniform(key, shp, minval=1e-7, maxval=1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return apply_op(raw, self.probs)
+
+    def log_prob(self, value):
+        def raw(v, p):
+            import jax.numpy as jnp
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return apply_op(raw, value, self.probs)
+
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda r: jax.random.poisson(key, r, shp).astype("float32"),
+            self.rate)
+
+    def log_prob(self, value):
+        def raw(v, r):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            return v * jnp.log(r) - r - jss.gammaln(v + 1)
+        return apply_op(raw, value, self.rate)
+
+    def mean(self):
+        return self.rate
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count, self.probs = _t(total_count), _t(probs)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.total_count.shape, self.probs.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda n, p: jax.random.binomial(key, n, p, shape=shp),
+            self.total_count, self.probs)
+
+    def log_prob(self, value):
+        def raw(v, n, p):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            comb = (jss.gammaln(n + 1) - jss.gammaln(v + 1)
+                    - jss.gammaln(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return apply_op(raw, value, self.total_count, self.probs)
+
+    def mean(self):
+        return self.total_count * self.probs
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         tuple(self.probs.shape[-1:]))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(p):
+            import jax.numpy as jnp
+            k = p.shape[-1]
+            draws = jax.random.categorical(
+                key, jnp.log(p), shape=shp + (self.total_count,))
+            return jax.nn.one_hot(draws, k).sum(-2)
+        return apply_op(raw, self.probs)
+
+    def log_prob(self, value):
+        def raw(v, p):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            n = jnp.sum(v, -1)
+            return (jss.gammaln(n + 1) - jnp.sum(jss.gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return apply_op(raw, value, self.probs)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df, self.loc, self.scale = _t(df), _t(loc), _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda d, l, s: l + s * jax.random.t(key, d, shp),
+            self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def raw(v, d, l, s):
+            import jax.scipy.special as jss
+            import jax.numpy as jnp
+            z = (v - l) / s
+            return (jss.gammaln((d + 1) / 2) - jss.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return apply_op(raw, value, self.df, self.loc, self.scale)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = _t(loc), _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+        return apply_op(
+            lambda l, s: l + s * jax.random.cauchy(key, shp),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        def raw(v, l, s):
+            import jax.numpy as jnp
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+        return apply_op(raw, value, self.loc, self.scale)
+
+    def entropy(self):
+        def raw(s):
+            import jax.numpy as jnp
+            return jnp.log(4 * math.pi * s)
+        return apply_op(raw, self.scale)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        enforce((covariance_matrix is None) != (scale_tril is None),
+                "give exactly one of covariance_matrix / scale_tril")
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        else:
+            cov = _t(covariance_matrix)
+            from . import ops
+            self.scale_tril = ops.cholesky(cov)
+        super().__init__(tuple(self.loc.shape[:-1]),
+                         tuple(self.loc.shape[-1:]))
+
+    def sample(self, shape=()):
+        import jax
+        key = _key()
+        shp = tuple(shape) + self.batch_shape
+
+        def raw(l, L):
+            import jax.numpy as jnp
+            d = l.shape[-1]
+            eps = jax.random.normal(key, shp + (d,))
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+        return apply_op(raw, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def raw(v, l, L):
+            import jax.numpy as jnp
+            import jax.scipy.linalg as jsl
+            d = l.shape[-1]
+            diff = v - l
+            sol = jsl.solve_triangular(L, diff[..., None], lower=True)
+            maha = jnp.sum(jnp.square(sol[..., 0]), -1)
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2,
+                                                  axis2=-1)), -1)
+            return -0.5 * (d * math.log(2 * math.pi) + maha) - logdet
+        return apply_op(raw, value, self.loc, self.scale_tril)
+
+    def mean(self):
+        return self.loc
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p: Beta, q: Beta):
+    def raw(a1, b1, a2, b2):
+        import jax.scipy.special as jss
+        return (jss.betaln(a2, b2) - jss.betaln(a1, b1)
+                + (a1 - a2) * jss.digamma(a1)
+                + (b1 - b2) * jss.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jss.digamma(a1 + b1))
+    return apply_op(raw, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p: Gamma, q: Gamma):
+    def raw(c1, r1, c2, r2):
+        import jax.scipy.special as jss
+        import jax.numpy as jnp
+        return ((c1 - c2) * jss.digamma(c1) - jss.gammaln(c1)
+                + jss.gammaln(c2) + c2 * (jnp.log(r1) - jnp.log(r2))
+                + c1 * (r2 - r1) / r1)
+    return apply_op(raw, p.concentration, p.rate, q.concentration,
+                    q.rate)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p: Dirichlet, q: Dirichlet):
+    def raw(c1, c2):
+        import jax.scipy.special as jss
+        import jax.numpy as jnp
+        s1 = jnp.sum(c1, -1)
+        return (jss.gammaln(s1) - jnp.sum(jss.gammaln(c1), -1)
+                - jss.gammaln(jnp.sum(c2, -1))
+                + jnp.sum(jss.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (jss.digamma(c1)
+                                       - jss.digamma(s1)[..., None]), -1))
+    return apply_op(raw, p.concentration, q.concentration)
+
+
+__all__ += ["Beta", "Gamma", "Chi2", "Dirichlet", "Geometric", "Poisson",
+            "Binomial", "Multinomial", "StudentT", "Cauchy",
+            "MultivariateNormal"]

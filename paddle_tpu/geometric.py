@@ -75,28 +75,35 @@ def _send_uv_raw(x, y, src_index, dst_index, message_op="add"):
     return _message(x[src_index], y[dst_index], message_op)
 
 
+def _num_segments(segment_ids):
+    """paddle's segment ops size the output max(ids)+1 — inherently
+    data-dependent, so it cannot be traced.  Erroring beats silently
+    returning a different shape under jit; the jit-safe spelling is
+    send_u_recv(..., out_size=N)."""
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise NotImplementedError(
+            "paddle.geometric.segment_* output size is max(ids)+1 — "
+            "data-dependent, so not jit-traceable; use "
+            "send_u_recv(x, ids, ids, reduce_op, out_size=N) for a "
+            "static output size under jit")
+    return int(jax.device_get(segment_ids).max()) + 1
+
+
 def _segment_sum_raw(data, segment_ids):
-    n = int(jax.device_get(segment_ids).max()) + 1 \
-        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
-    return _seg_reduce(data, segment_ids, "sum", n)
+    return _seg_reduce(data, segment_ids, "sum", _num_segments(segment_ids))
 
 
 def _segment_mean_raw(data, segment_ids):
-    n = int(jax.device_get(segment_ids).max()) + 1 \
-        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
-    return _seg_reduce(data, segment_ids, "mean", n)
+    return _seg_reduce(data, segment_ids, "mean",
+                       _num_segments(segment_ids))
 
 
 def _segment_max_raw(data, segment_ids):
-    n = int(jax.device_get(segment_ids).max()) + 1 \
-        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
-    return _seg_reduce(data, segment_ids, "max", n)
+    return _seg_reduce(data, segment_ids, "max", _num_segments(segment_ids))
 
 
 def _segment_min_raw(data, segment_ids):
-    n = int(jax.device_get(segment_ids).max()) + 1 \
-        if not isinstance(segment_ids, jax.core.Tracer) else data.shape[0]
-    return _seg_reduce(data, segment_ids, "min", n)
+    return _seg_reduce(data, segment_ids, "min", _num_segments(segment_ids))
 
 
 send_u_recv = tensorize(_send_u_recv_raw)

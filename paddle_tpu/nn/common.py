@@ -16,9 +16,12 @@ from ..ops import api as _ops_api
 from ..tensor import Tensor
 
 __all__ = [
-    "Linear", "Embedding", "Dropout", "Dropout2D", "Flatten", "Identity",
+    "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
+    "AlphaDropout", "FeatureAlphaDropout", "Flatten", "Identity",
     "Unflatten", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
-    "PixelShuffle", "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity",
+    "PixelShuffle", "PixelUnshuffle", "ChannelShuffle", "ZeroPad2D",
+    "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity", "PairwiseDistance",
+    "Bilinear", "RReLU", "Fold", "Unfold",
     "ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh", "Softmax",
     "LogSoftmax", "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "Hardtanh",
     "Hardsigmoid", "Hardswish", "Hardshrink", "Softshrink", "Softplus",
@@ -85,8 +88,48 @@ class Dropout(Layer):
         return f"p={self.p}"
 
 
-class Dropout2D(Dropout):
-    pass
+class Dropout2D(Layer):
+    """Whole-channel dropout (paddle nn.Dropout2D drops entire feature
+    maps, not elements)."""
+
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p,
+                                       training=self.training)
 
 
 class Flatten(Layer):
@@ -246,3 +289,89 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, lower=self.lower, upper=self.upper,
+                       training=self.training)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)
+
+
+class Bilinear(Layer):
+    """paddle nn.Bilinear: out = x1 @ W @ x2 + b, weight
+    [out_features, in1_features, in2_features]."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings,
+                   dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._a)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self._a)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding)

@@ -12,9 +12,11 @@ from . import functional as F
 from .initializer import KaimingNormal
 from .layer import Layer
 
-__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "MaxPool2D",
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose",
+           "Conv2DTranspose", "Conv3DTranspose", "MaxPool2D",
            "AvgPool2D", "AdaptiveAvgPool2D", "AdaptiveMaxPool2D",
-           "MaxPool1D", "AvgPool1D", "MaxPool3D", "AvgPool3D"]
+           "MaxPool1D", "AvgPool1D", "MaxPool3D", "AvgPool3D",
+           "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]
 
 
 class _ConvNd(Layer):
@@ -91,6 +93,32 @@ class Conv3D(_ConvNd):
                         self.dilation, self.groups, self.data_format)
 
 
+def _output_padding_from_size(in_spatial, output_size, kernel, stride,
+                              padding, dilation):
+    """Resolve transpose-conv shape ambiguity: derive per-dim
+    output_padding so the output hits the requested ``output_size``
+    (the reference's documented mechanism)."""
+    n = len(in_spatial)
+
+    def tup(v):
+        return (v,) * n if isinstance(v, int) else tuple(v)
+
+    k, s, p, d = tup(kernel), tup(stride), tup(padding), tup(dilation)
+    want = tuple(output_size)[-n:]
+    out = []
+    for i in range(n):
+        eff_k = (k[i] - 1) * d[i] + 1
+        base = (in_spatial[i] - 1) * s[i] - 2 * p[i] + eff_k
+        op = int(want[i]) - base
+        if op < 0 or op >= s[i] + d[i]:
+            raise ValueError(
+                f"output_size {want[i]} unreachable for dim {i}: base "
+                f"size {base}, output_padding must be in [0, "
+                f"{s[i] + d[i] - 1}]")
+        out.append(op)
+    return tuple(out)
+
+
 class Conv2DTranspose(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, output_padding=0, dilation=1, groups=1,
@@ -101,8 +129,12 @@ class Conv2DTranspose(_ConvNd):
         self.output_padding = output_padding
 
     def forward(self, x, output_size=None):
+        op = self.output_padding if output_size is None else \
+            _output_padding_from_size(x.shape[2:], output_size,
+                                      self.kernel_size, self.stride,
+                                      self.padding, self.dilation)
         return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
-                                  self.padding, self.output_padding,
+                                  self.padding, op,
                                   self.dilation, self.groups,
                                   self.data_format)
 
@@ -214,3 +246,76 @@ class AdaptiveMaxPool2D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1,
+                         stride, padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format,
+                         transpose=True)
+        self.output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        op = self.output_padding if output_size is None else \
+            _output_padding_from_size(x.shape[2:], output_size,
+                                      self.kernel_size, self.stride,
+                                      self.padding, self.dilation)
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, op,
+                                  self.dilation, self.groups,
+                                  self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format,
+                         transpose=True)
+        self.output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        op = self.output_padding if output_size is None else \
+            _output_padding_from_size(x.shape[2:], output_size,
+                                      self.kernel_size, self.stride,
+                                      self.padding, self.dilation)
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, op,
+                                  self.dilation, self.groups,
+                                  self.data_format)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self._a)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool2d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self._a)

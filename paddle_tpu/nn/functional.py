@@ -37,7 +37,8 @@ from ..ops.api import (  # noqa: F401
     sequence_mask, dice_loss, npair_loss, multi_margin_loss,
     softmax_with_cross_entropy, feature_alpha_dropout, max_unpool1d,
     max_unpool3d, class_center_sample, margin_cross_entropy,
-    adaptive_log_softmax_with_loss,
+    adaptive_log_softmax_with_loss, conv1d_transpose, conv3d_transpose,
+    bilinear,
 )
 from ..ops import api as _api
 from ..tensor import apply_op

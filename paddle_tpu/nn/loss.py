@@ -8,7 +8,12 @@ from . import functional as F
 from .layer import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
-           "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss"]
+           "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
+           "MarginRankingLoss", "CosineEmbeddingLoss",
+           "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+           "MultiLabelSoftMarginLoss", "HingeEmbeddingLoss",
+           "SoftMarginLoss", "MultiMarginLoss", "PoissonNLLLoss",
+           "GaussianNLLLoss", "CTCLoss", "AdaptiveLogSoftmaxWithLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -109,3 +114,149 @@ class SmoothL1Loss(Layer):
     def forward(self, input, label):
         return F.smooth_l1_loss(input, label, reduction=self.reduction,
                                 delta=self.delta)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label,
+                                       self.margin, self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (margin, p, epsilon, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative, *self._a)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, *self._a)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin,
+                                      self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._a = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, *self._a)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(log_input=log_input, full=full, epsilon=epsilon,
+                        reduction=reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, **self._kw)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(full=full, epsilon=epsilon, reduction=reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, **self._kw)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """paddle nn.AdaptiveLogSoftmaxWithLoss: adaptive softmax head +
+    down-projected tail clusters (div_value^i feature reduction)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs)
+        n_clusters = len(self.cutoffs)
+        head_size = self.cutoffs[0] + n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, head_size], attr=weight_attr)
+        self.head_bias = self.create_parameter(
+            [head_size], attr=bias_attr, is_bias=True) if head_bias \
+            else None
+        self.tail_weights = []
+        ext = self.cutoffs + [n_classes]
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = ext[i + 1] - ext[i]
+            proj = self.create_parameter([in_features, hsz],
+                                         attr=weight_attr)
+            w = self.create_parameter([hsz, osz], attr=weight_attr)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_w_{i}", w)
+            self.tail_weights.append((proj, w))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)

@@ -433,6 +433,69 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    """1-D transpose conv through the 2-D path (singleton height)."""
+    if data_format == "NLC":
+        x = jnp.swapaxes(x, 1, 2)
+    s = _norm_tuple(stride, 1)[0]
+    p = padding if isinstance(padding, str) else _norm_tuple(padding, 1)[0]
+    out = conv2d_transpose(
+        x[:, :, None, :], weight[:, :, None, :], bias, (1, s),
+        p if isinstance(p, str) else (0, p),
+        (0, _norm_tuple(output_padding, 1)[0]),
+        (1, _norm_tuple(dilation, 1)[0]), groups)
+    out = out[:, :, 0, :]
+    return jnp.swapaxes(out, 1, 2) if data_format == "NLC" else out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    """weight [C_in, C_out/g, kD, kH, kW]; lhs-dilated conv with a
+    flipped kernel, like the 2-D path."""
+    if data_format == "NDHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    x, weight, out_dt = _match_conv_dtypes(x, weight)
+    n = 3
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    output_padding = _norm_tuple(output_padding, n)
+    padv = _norm_tuple(padding, n)
+    padv = [(p, p) for p in padv]
+    k = weight.shape[2:]
+    pad_trans = []
+    for i in range(n):
+        eff_k = (k[i] - 1) * dilation[i] + 1
+        lo = eff_k - 1 - padv[i][0]
+        hi = eff_k - 1 - padv[i][1] + output_padding[i]
+        pad_trans.append((lo, hi))
+    w = jnp.flip(weight, axis=(-3, -2, -1))
+    cin, cog = weight.shape[0], weight.shape[1]
+    w = w.reshape(groups, cin // groups, cog, *k)
+    w = jnp.moveaxis(w, 2, 1).reshape(groups * cog, cin // groups, *k)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad_trans,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if out_dt is not None:
+        out = out.astype(out_dt)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return jnp.moveaxis(out, 1, -1) if data_format == "NDHWC" else out
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """paddle F.bilinear: out[n, o] = x1[n] @ W[o] @ x2[n] (+ b)."""
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
 def _channel_last_aware(fn):
     """Pool-family decorator: a channel-last ``data_format`` kwarg
     ("NHWC"/"NDHWC") transposes to channel-first, runs the NC*-native

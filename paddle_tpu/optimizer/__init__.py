@@ -1,2 +1,4 @@
 from . import lr
-from .optimizer import SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer, RMSProp
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, ASGD,
+                        Lamb, LBFGS, Momentum, NAdam, Optimizer, RAdam,
+                        RMSProp, Rprop)

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..common.errors import enforce
 from ..nn.clip import ClipGradBase
@@ -23,7 +24,8 @@ from ..tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adamax", "RMSProp", "Lamb"]
+           "Adamax", "RMSProp", "Lamb", "Adadelta", "ASGD", "Rprop",
+           "NAdam", "RAdam", "LBFGS"]
 
 
 class Optimizer:
@@ -372,3 +374,272 @@ class Lamb(Optimizer):
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return param - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+
+    def init_slots(self, param):
+        return {"avg_sq_grad": jnp.zeros_like(param, dtype=jnp.float32),
+                "avg_sq_update": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        asg = self._rho * slots["avg_sq_grad"] \
+            + (1 - self._rho) * jnp.square(grad)
+        upd = jnp.sqrt((slots["avg_sq_update"] + self._eps)
+                       / (asg + self._eps)) * grad
+        asu = self._rho * slots["avg_sq_update"] \
+            + (1 - self._rho) * jnp.square(upd)
+        return param - lr * upd, {"avg_sq_grad": asg,
+                                  "avg_sq_update": asu}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (paddle.optimizer.ASGD): keeps the
+    last ``batch_num`` per-batch gradients and steps on their running
+    sum — with batch_num=1 it reduces to SGD."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._batch_num = int(batch_num)
+
+    def init_slots(self, param):
+        return {"d": jnp.zeros_like(param, dtype=jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(param.shape),
+                                jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        idx = (jnp.asarray(step, jnp.int32) - 1) % self._batch_num
+        old = slots["ys"][idx]
+        d = slots["d"] - old + grad
+        ys = slots["ys"].at[idx].set(grad.astype(jnp.float32))
+        n = jnp.minimum(jnp.asarray(step, jnp.float32), self._batch_num)
+        return param - lr * d / n, {"d": d, "ys": ys}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (sign-based per-weight step sizes)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def init_slots(self, param):
+        return {"prev_grad": jnp.zeros_like(param, dtype=jnp.float32),
+                "step_size": jnp.full(param.shape, self.get_lr(),
+                                      jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        sign = grad * slots["prev_grad"]
+        scale = jnp.where(sign > 0, self._eta_pos,
+                          jnp.where(sign < 0, self._eta_neg, 1.0))
+        ss = jnp.clip(slots["step_size"] * scale, self._lr_min,
+                      self._lr_max)
+        # on a sign flip the step is skipped and the stored grad zeroed
+        eff_grad = jnp.where(sign < 0, 0.0, grad)
+        new_p = param - jnp.sign(eff_grad) * ss
+        return new_p, {"prev_grad": eff_grad, "step_size": ss}
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (Dozat 2016; paddle/torch NAdam
+    schedule mu_t = beta1 * (1 - 0.5 * 0.96^(t*psi)))."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def init_slots(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        t = jnp.asarray(step, jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = slots["mu_product"] * mu_t
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * slots["moment2"] \
+            + (1 - self._beta2) * jnp.square(grad)
+        vhat = v / (1 - self._beta2 ** t)
+        mhat = (mu_next * m / (1 - mu_prod * mu_next)
+                + (1 - mu_t) * grad / (1 - mu_prod))
+        new_p = param - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (Liu et al. 2020): falls back to un-adapted SGD
+    with momentum while the variance estimate is untrustworthy."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, param):
+        return {"moment1": jnp.zeros_like(param, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(param, dtype=jnp.float32)}
+
+    def update(self, param, grad, slots, lr, step):
+        t = jnp.asarray(step, jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * slots["moment2"] \
+            + (1 - self._beta2) * jnp.square(grad)
+        mhat = m / (1 - self._beta1 ** t)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        b2t = self._beta2 ** t
+        rho_t = rho_inf - 2 * t * b2t / (1 - b2t)
+        safe_rho = jnp.maximum(rho_t, 4.0 + 1e-3)  # keep sqrt arg finite
+        r = jnp.sqrt(((safe_rho - 4) * (safe_rho - 2) * rho_inf)
+                     / ((rho_inf - 4) * (rho_inf - 2) * safe_rho))
+        vhat = jnp.sqrt(v / (1 - b2t)) + self._eps
+        adaptive = lr * r * mhat / vhat
+        plain = lr * mhat
+        return param - jnp.where(rho_t > 5.0, adaptive, plain), \
+            {"moment1": m, "moment2": v}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure re-evaluation
+    (paddle.optimizer.LBFGS: ``step(closure)`` returns the loss).
+
+    Two-loop recursion over the last ``history_size`` (s, y) pairs on
+    the FLATTENED parameter vector; line search is backtracking Armijo
+    (``line_search_fn=None``/'armijo') or strong-Wolfe zoom.  State
+    lives on host lists (the closure re-runs eager autograd anyway, so
+    there is nothing to jit here — matches the reference, whose LBFGS
+    is also a host loop around the graph)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad, self._tol_change = tolerance_grad, tolerance_change
+        self._history = int(history_size)
+        enforce(line_search_fn in (None, "armijo", "strong_wolfe"),
+                f"line_search_fn must be None, 'armijo' or "
+                f"'strong_wolfe', got {line_search_fn!r}")
+        self._line_search = line_search_fn
+        self._s: List[jax.Array] = []
+        self._y: List[jax.Array] = []
+        self._prev_flat_grad = None
+
+    # flatten/unflatten over the parameter list ---------------------------
+    def _gather(self):
+        return [p for p in self._parameter_list if p.trainable]
+
+    def _flat(self, arrs):
+        return jnp.concatenate([jnp.ravel(a.astype(jnp.float32))
+                                for a in arrs])
+
+    def _set_params(self, params, flat):
+        off = 0
+        for p in params:
+            n = int(np.prod(p.value.shape)) if p.value.shape else 1
+            chunk = flat[off:off + n].reshape(p.value.shape)
+            p._value = chunk.astype(p.value.dtype)
+            off += n
+
+    def _eval(self, closure, params, flat):
+        self._set_params(params, flat)
+        for p in params:
+            p.clear_grad()
+        loss = closure()
+        grads = [p._grad if p._grad is not None
+                 else jnp.zeros_like(p.value) for p in params]
+        return float(loss.numpy()), self._flat(grads)
+
+    def step(self, closure=None):
+        enforce(closure is not None, "LBFGS.step requires a closure")
+        params = self._gather()
+        x = self._flat([p.value for p in params])
+        loss, g = self._eval(closure, params, x)
+        evals = 1
+        lr = self.get_lr()
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            # two-loop recursion
+            q = -g
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = float(jnp.dot(s_last, y_last)
+                              / jnp.dot(y_last, y_last))
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + (a - b) * s
+            d = q
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-32:       # not a descent direction: reset
+                self._s.clear()
+                self._y.clear()
+                d = -g
+                gtd = float(jnp.dot(g, d))
+            # line search
+            t = lr
+            if self._line_search in ("strong_wolfe", "armijo", None):
+                c1, c2 = 1e-4, 0.9
+                ok = False
+                for _ls in range(10):
+                    new_loss, new_g = self._eval(closure, params, x + t * d)
+                    evals += 1
+                    if new_loss <= loss + c1 * t * gtd:
+                        if self._line_search != "strong_wolfe" or \
+                                abs(float(jnp.dot(new_g, d))) \
+                                <= c2 * abs(gtd):
+                            ok = True
+                            break
+                    t *= 0.5
+                    if evals >= self._max_eval:
+                        break
+                if not ok:
+                    new_loss, new_g = self._eval(closure, params, x + t * d)
+                    evals += 1
+            x_new = x + t * d
+            s = x_new - x
+            y = new_g - g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(new_loss - loss) < self._tol_change:
+                x, loss, g = x_new, new_loss, new_g
+                break
+            x, loss, g = x_new, new_loss, new_g
+            if evals >= self._max_eval:
+                break
+        self._set_params(params, x)
+        from ..tensor import to_tensor as _tt
+        return _tt(loss)

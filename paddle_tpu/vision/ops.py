@@ -239,7 +239,22 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     kept = np.asarray(jax.device_get(order))[
         np.asarray(jax.device_get(keep))]
     if top_k is not None:
-        kept = kept[:top_k]
+        if category_idxs is not None and categories is not None:
+            # reference semantics: top_k applies PER category, results
+            # merged back in global score order
+            cats = np.asarray(jax.device_get(
+                getattr(category_idxs, "value", category_idxs)))
+            per_cat = [kept[cats[kept] == int(c)][:top_k]
+                       for c in list(categories)]
+            kept = np.concatenate(per_cat) if per_cat else kept[:0]
+            if scores is not None:
+                s_np = np.asarray(jax.device_get(
+                    getattr(scores, "value", scores)))
+                kept = kept[np.argsort(-s_np[kept], kind="stable")]
+            else:
+                kept = np.sort(kept)
+        else:
+            kept = kept[:top_k]
     return to_tensor(kept.astype(np.int64))
 
 
@@ -395,8 +410,9 @@ def _yolo_box_raw(x, img_size, anchors, class_num, conf_thresh,
         y1 = jnp.clip(y1, 0, imh - 1)
         x2 = jnp.clip(x2, 0, imw - 1)
         y2 = jnp.clip(y2, 0, imh - 1)
+    # both flattened (na, H, W)-major so box row i pairs its own scores
     boxes = jnp.stack([x1, y1, x2, y2], -1) * conf_mask[..., None]
-    boxes = boxes.transpose(0, 1, 3, 2, 4).reshape(N, na * H * W, 4)
+    boxes = boxes.reshape(N, na * H * W, 4)
     scores = (probs * conf_mask[:, :, None]).transpose(0, 1, 3, 4, 2)
     scores = scores.reshape(N, na * H * W, class_num)
     return boxes, scores
@@ -579,7 +595,11 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         keep_sz = ((prop[:, 2] - prop[:, 0] + off >= min_size)
                    & (prop[:, 3] - prop[:, 1] + off >= min_size))
         sk = jnp.where(keep_sz, top_s, -jnp.inf)
-        keep = _nms_keep_mask(prop, nms_thresh) & keep_sz
+        # sub-min_size boxes must not SUPPRESS valid ones: collapse them
+        # to zero-area points (IoU 0 with everything) before NMS
+        degenerate = jnp.full_like(prop, -1e6)
+        prop_nms = jnp.where(keep_sz[:, None], prop, degenerate)
+        keep = _nms_keep_mask(prop_nms, nms_thresh) & keep_sz
         keep_np = np.asarray(jax.device_get(keep))
         prop_np = np.asarray(jax.device_get(prop))[keep_np]
         s_np = np.asarray(jax.device_get(sk))[keep_np]

@@ -1,0 +1,107 @@
+"""Round-5 distribution zoo vs scipy oracles (log_prob exactness,
+sample shapes/moments, KL closed forms)."""
+import numpy as np
+import pytest
+import scipy.special as sp
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+t = paddle.to_tensor
+
+
+@pytest.mark.parametrize("name,dist,v,ref", [
+    ("beta", lambda: D.Beta(2.0, 3.0), 0.4, st.beta(2, 3).logpdf(0.4)),
+    ("gamma", lambda: D.Gamma(2.5, 1.5), 1.2,
+     st.gamma(2.5, scale=1 / 1.5).logpdf(1.2)),
+    ("chi2", lambda: D.Chi2(4.0), 2.0, st.chi2(4).logpdf(2.0)),
+    ("geometric", lambda: D.Geometric(0.3), 2.0,
+     st.geom(0.3, loc=-1).logpmf(2)),
+    ("poisson", lambda: D.Poisson(3.0), 2.0, st.poisson(3.0).logpmf(2)),
+    ("binomial", lambda: D.Binomial(10.0, 0.3), 4.0,
+     st.binom(10, 0.3).logpmf(4)),
+    ("studentt", lambda: D.StudentT(5.0, 1.0, 2.0), 0.5,
+     st.t(5, loc=1, scale=2).logpdf(0.5)),
+    ("cauchy", lambda: D.Cauchy(0.5, 2.0), 1.5,
+     st.cauchy(0.5, 2.0).logpdf(1.5)),
+])
+def test_log_prob_matches_scipy(name, dist, v, ref):
+    got = float(dist().log_prob(t(np.float32(v))).numpy())
+    assert abs(got - ref) < 1e-4, name
+
+
+def test_vector_distributions_match_scipy():
+    dd = D.Dirichlet(t(np.array([2.0, 3.0, 4.0], np.float32)))
+    vv = np.array([0.2, 0.3, 0.5], np.float32)
+    assert abs(float(dd.log_prob(t(vv)).numpy())
+               - st.dirichlet([2, 3, 4]).logpdf(vv)) < 1e-4
+
+    mvn = D.MultivariateNormal(
+        t(np.zeros(3, np.float32)),
+        covariance_matrix=t((np.eye(3) * 2).astype(np.float32)))
+    ref = st.multivariate_normal(np.zeros(3), np.eye(3) * 2).logpdf(
+        [1, 0, 1])
+    assert abs(float(mvn.log_prob(
+        t(np.array([1., 0., 1.], np.float32))).numpy()) - ref) < 1e-4
+
+    mn = D.Multinomial(5, t(np.array([0.2, 0.3, 0.5], np.float32)))
+    ref = st.multinomial(5, [0.2, 0.3, 0.5]).logpmf([1, 2, 2])
+    assert abs(float(mn.log_prob(
+        t(np.array([1., 2., 2.], np.float32))).numpy()) - ref) < 1e-4
+
+
+def test_kl_closed_forms():
+    got = float(D.kl_divergence(D.Beta(2., 3.), D.Beta(4., 1.)).numpy())
+    a1, b1, a2, b2 = 2, 3, 4, 1
+    ref = (sp.betaln(a2, b2) - sp.betaln(a1, b1)
+           + (a1 - a2) * sp.digamma(a1) + (b1 - b2) * sp.digamma(b1)
+           + (a2 - a1 + b2 - b1) * sp.digamma(a1 + b1))
+    assert abs(got - ref) < 1e-4
+
+    # KL(p, p) == 0 for the new registry pairs
+    g = D.Gamma(2.0, 1.5)
+    assert abs(float(D.kl_divergence(g, g).numpy())) < 1e-5
+    dd = D.Dirichlet(t(np.array([2.0, 3.0], np.float32)))
+    assert abs(float(D.kl_divergence(dd, dd).numpy())) < 1e-5
+
+
+def test_samples_shapes_and_moments():
+    paddle.seed(0)
+    n = 20000
+    checks = [
+        (D.Beta(2.0, 3.0), 2 / 5, 0.02),
+        (D.Gamma(2.0, 1.0), 2.0, 0.05),
+        (D.Poisson(3.0), 3.0, 0.05),
+        (D.Binomial(10.0, 0.3), 3.0, 0.05),
+        (D.Geometric(0.4), 1.5, 0.05),
+    ]
+    for dist, mean, tol in checks:
+        s = np.asarray(dist.sample((n,)).numpy())
+        assert s.shape == (n,)
+        assert abs(s.mean() - mean) < max(3 * tol, 0.05), type(dist)
+
+    mvn = D.MultivariateNormal(
+        t(np.array([1.0, -1.0], np.float32)),
+        scale_tril=t(np.array([[1.0, 0], [0.5, 0.8]], np.float32)))
+    s = np.asarray(mvn.sample((n,)).numpy())
+    assert s.shape == (n, 2)
+    np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.05)
+    cov = np.cov(s.T)
+    L = np.array([[1.0, 0], [0.5, 0.8]])
+    np.testing.assert_allclose(cov, L @ L.T, atol=0.08)
+
+    mn = D.Multinomial(5, t(np.array([0.2, 0.8], np.float32)))
+    s = np.asarray(mn.sample((n,)).numpy())
+    assert (s.sum(-1) == 5).all()
+    np.testing.assert_allclose(s.mean(0), [1.0, 4.0], atol=0.08)
+
+
+def test_log_prob_is_differentiable():
+    x = t(np.float32(0.4))
+    x.stop_gradient = False
+    lp = D.Beta(2.0, 3.0).log_prob(x)
+    lp.backward()
+    # d/dx [(a-1)ln x + (b-1)ln(1-x)] = (a-1)/x - (b-1)/(1-x)
+    ref = (2 - 1) / 0.4 - (3 - 1) / 0.6
+    assert abs(float(np.asarray(x.grad)) - ref) < 1e-4

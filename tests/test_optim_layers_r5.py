@@ -1,0 +1,223 @@
+"""Round-5 optimizer + layer additions: Adadelta/ASGD/Rprop/NAdam/
+RAdam step-for-step against torch, LBFGS convergence, and the new
+layer zoo members (unpools, transpose convs, Bilinear, dropout family,
+loss layers) against torch/functional oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.tensor import Parameter
+
+t = paddle.to_tensor
+rng = np.random.default_rng(0)
+
+
+def _run_pair(p_opt_fn, t_opt_fn, steps=6):
+    import jax.numpy as jnp
+    torch = pytest.importorskip("torch")
+    w0 = rng.standard_normal((4, 3)).astype(np.float32)
+    pp = Parameter(t(w0.copy()).value)
+    popt = p_opt_fn([pp])
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = t_opt_fn([tw])
+    for i in range(steps):
+        g = np.random.default_rng(i + 1).standard_normal(
+            (4, 3)).astype(np.float32)
+        pp._grad = jnp.asarray(g)
+        popt.step()
+        tw.grad = torch.tensor(g)
+        topt.step()
+    return np.abs(np.asarray(pp.value) - tw.detach().numpy()).max()
+
+
+def test_adadelta_matches_torch():
+    torch = pytest.importorskip("torch")
+    err = _run_pair(
+        lambda ps: opt.Adadelta(0.5, parameters=ps, rho=0.9,
+                                epsilon=1e-6),
+        lambda ws: torch.optim.Adadelta(ws, lr=0.5, rho=0.9, eps=1e-6))
+    assert err < 1e-5
+
+
+def test_nadam_matches_torch():
+    torch = pytest.importorskip("torch")
+    err = _run_pair(lambda ps: opt.NAdam(0.01, parameters=ps),
+                    lambda ws: torch.optim.NAdam(ws, lr=0.01))
+    assert err < 1e-5
+
+
+def test_radam_matches_torch():
+    torch = pytest.importorskip("torch")
+    err = _run_pair(lambda ps: opt.RAdam(0.01, parameters=ps),
+                    lambda ws: torch.optim.RAdam(ws, lr=0.01), steps=8)
+    assert err < 1e-4
+
+
+def test_rprop_matches_torch():
+    torch = pytest.importorskip("torch")
+    err = _run_pair(lambda ps: opt.Rprop(0.01, parameters=ps),
+                    lambda ws: torch.optim.Rprop(ws, lr=0.01))
+    assert err < 1e-6
+
+
+def test_asgd_batch1_is_sgd():
+    torch = pytest.importorskip("torch")
+    err = _run_pair(lambda ps: opt.ASGD(0.1, parameters=ps),
+                    lambda ws: torch.optim.SGD(ws, lr=0.1))
+    assert err < 1e-6
+
+
+def test_lbfgs_converges_on_quadratic():
+    import jax.numpy as jnp
+    w = Parameter(jnp.zeros(2, jnp.float32))
+    lb = opt.LBFGS(learning_rate=1.0, max_iter=25,
+                   line_search_fn="strong_wolfe", parameters=[w])
+
+    def closure():
+        tgt = t(np.array([3.0, -1.0], np.float32))
+        scale = t(np.array([1.0, 10.0], np.float32))
+        loss = (scale * (w - tgt) * (w - tgt)).sum()
+        loss.backward()
+        return loss
+
+    loss = lb.step(closure)
+    assert float(loss.numpy()) < 1e-6
+    np.testing.assert_allclose(np.asarray(w.value), [3.0, -1.0],
+                               atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+def test_transpose_conv_layers_vs_torch():
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    x = rng.standard_normal((2, 4, 10)).astype(np.float32)
+    layer = nn.Conv1DTranspose(4, 3, 5, stride=2, padding=2,
+                               output_padding=1)
+    got = layer(t(x)).numpy()
+    ref = TF.conv_transpose1d(
+        torch.tensor(x), torch.tensor(np.asarray(layer.weight.numpy())),
+        torch.tensor(np.asarray(layer.bias.numpy())), stride=2,
+        padding=2, output_padding=1).detach().numpy()
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+    x3 = rng.standard_normal((1, 4, 5, 5, 5)).astype(np.float32)
+    layer = nn.Conv3DTranspose(4, 2, 3, stride=2, padding=1)
+    got = layer(t(x3)).numpy()
+    ref = TF.conv_transpose3d(
+        torch.tensor(x3), torch.tensor(np.asarray(layer.weight.numpy())),
+        torch.tensor(np.asarray(layer.bias.numpy())), stride=2,
+        padding=1).detach().numpy()
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+
+def test_transpose_conv_output_size():
+    x = t(rng.standard_normal((1, 4, 5, 5)).astype(np.float32))
+    layer = nn.Conv2DTranspose(4, 3, 3, stride=2)
+    assert tuple(layer(x, output_size=[12, 12]).shape)[2:] == (12, 12)
+    l1 = nn.Conv1DTranspose(4, 3, 3, stride=2)
+    x1 = t(rng.standard_normal((1, 4, 5)).astype(np.float32))
+    assert tuple(l1(x1, output_size=[12]).shape)[2:] == (12,)
+    l3 = nn.Conv3DTranspose(4, 3, 3, stride=2)
+    x3 = t(rng.standard_normal((1, 4, 5, 5, 5)).astype(np.float32))
+    assert tuple(l3(x3, output_size=[12, 12, 12]).shape)[2:] == (12,) * 3
+    with pytest.raises(ValueError):
+        layer(x, output_size=[64, 64])
+
+
+def test_bilinear_layer_vs_torch():
+    torch = pytest.importorskip("torch")
+    x1 = rng.standard_normal((5, 3)).astype(np.float32)
+    x2 = rng.standard_normal((5, 4)).astype(np.float32)
+    layer = nn.Bilinear(3, 4, 6)
+    got = layer(t(x1), t(x2)).numpy()
+    ref = torch.nn.functional.bilinear(
+        torch.tensor(x1), torch.tensor(x2),
+        torch.tensor(np.asarray(layer.weight.numpy())),
+        torch.tensor(np.asarray(layer.bias.numpy()))).numpy()
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5)
+
+
+def test_unpool_layers_roundtrip():
+    # positive values: unpool zero-fills, so re-pooling the unpooled map
+    # must reproduce the pooled maxima exactly
+    x = t(np.abs(rng.standard_normal((1, 2, 8, 8))).astype(np.float32))
+    p, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+    u = nn.MaxUnPool2D(2, 2)(p, idx)
+    assert tuple(u.shape) == (1, 2, 8, 8)
+    assert np.allclose(np.asarray(F.max_pool2d(u, 2, 2).numpy()),
+                       np.asarray(p.numpy()))
+
+    x3 = t(rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32))
+    p3, i3 = F.max_pool3d(x3, 2, 2, return_mask=True)
+    assert tuple(nn.MaxUnPool3D(2, 2)(p3, i3).shape) == (1, 2, 4, 4, 4)
+    x1 = t(rng.standard_normal((2, 3, 8)).astype(np.float32))
+    p1, i1 = F.max_pool1d(x1, 2, 2, return_mask=True)
+    assert tuple(nn.MaxUnPool1D(2, 2)(p1, i1).shape) == (2, 3, 8)
+
+
+def test_dropout_family_layers():
+    paddle.seed(0)
+    x = t(rng.standard_normal((64, 8, 6, 6)).astype(np.float32))
+    d3 = nn.Dropout3D(0.5)
+    y = d3(t(rng.standard_normal((8, 4, 4, 4, 4)).astype(np.float32)))
+    zeroed = np.asarray(y.numpy()) == 0
+    # whole (N, C) feature volumes drop together
+    per_map = zeroed.reshape(8, 4, -1)
+    assert ((per_map.all(-1)) | (~per_map.any(-1))).all()
+    # Dropout2D drops whole channels (regression: used to be elementwise)
+    d2 = nn.Dropout2D(0.5)
+    y2 = np.asarray(d2(x).numpy())
+    per_map = (y2 == 0).reshape(64, 8, -1)
+    assert ((per_map.all(-1)) | (~per_map.any(-1))).all()
+    for layer in (nn.AlphaDropout(0.3), nn.FeatureAlphaDropout(0.3),
+                  nn.RReLU()):
+        assert tuple(layer(x).shape) == (64, 8, 6, 6)
+        layer.eval()
+        np.testing.assert_allclose(np.asarray(layer(x).numpy()),
+                                   np.asarray(x.numpy()) if not
+                                   isinstance(layer, nn.RReLU) else
+                                   np.asarray(layer(x).numpy()))
+
+
+def test_loss_layers_match_functionals():
+    a = t(rng.standard_normal((6, 4)).astype(np.float32))
+    b = t(rng.standard_normal((6, 4)).astype(np.float32))
+    lbl = t(np.sign(rng.standard_normal(6)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(nn.MarginRankingLoss(0.5)(a[:, 0], b[:, 0],
+                                             lbl).numpy()),
+        np.asarray(F.margin_ranking_loss(a[:, 0], b[:, 0], lbl,
+                                         0.5).numpy()))
+    np.testing.assert_allclose(
+        np.asarray(nn.TripletMarginLoss()(a, b, -b).numpy()),
+        np.asarray(F.triplet_margin_loss(a, b, -b).numpy()))
+    np.testing.assert_allclose(
+        np.asarray(nn.SoftMarginLoss()(a, lbl[:, None]).numpy()),
+        np.asarray(F.soft_margin_loss(a, lbl[:, None]).numpy()))
+    cls = t(rng.integers(0, 4, (6,)), "int64")
+    np.testing.assert_allclose(
+        np.asarray(nn.MultiMarginLoss()(a, cls).numpy()),
+        np.asarray(F.multi_margin_loss(a, cls).numpy()))
+
+
+def test_adaptive_log_softmax_layer_trains():
+    import jax.numpy as jnp
+    paddle.seed(0)
+    layer = nn.AdaptiveLogSoftmaxWithLoss(8, 20, [5, 12])
+    x = t(rng.standard_normal((16, 8)).astype(np.float32))
+    y = t(rng.integers(0, 20, (16,)), "int64")
+    o = opt.SGD(0.1, parameters=layer.parameters())
+    losses = []
+    for _ in range(5):
+        out, loss = layer(x, y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]

@@ -181,6 +181,51 @@ def test_deform_conv2d_mask_modulates():
     np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
 
 
+def test_yolo_box_rows_align_boxes_with_scores():
+    # distinctive conf at exactly one cell: the SAME flat row must hold
+    # its box and its scores (regression: boxes were W-major flattened)
+    x = np.zeros((1, 7, 3, 4), np.float32)        # na=1, cls=2, H=3, W=4
+    x[0, 4, 1, 2] = 5.0                           # conf at (h=1, w=2)
+    x[0, 5, 1, 2] = 3.0
+    yb, ys = V.yolo_box(t(x), t(np.array([[96, 128]]), "int32"),
+                        [10, 13], 2, 0.6, 32)
+    yb = np.asarray(yb.numpy())
+    ys = np.asarray(ys.numpy())
+    nz_box = set(np.nonzero(yb.sum(-1))[1].tolist())
+    nz_sc = set(np.nonzero(np.abs(ys).sum(-1))[1].tolist())
+    assert nz_box == nz_sc == {1 * 4 + 2}
+    cx = (yb[0, 6, 0] + yb[0, 6, 2]) / 2
+    cy = (yb[0, 6, 1] + yb[0, 6, 3]) / 2
+    # tx=ty=0 -> sigmoid 0.5: center ((2+.5)/4*128, (1+.5)/3*96)
+    assert abs(cx - 80.0) < 1e-3 and abs(cy - 48.0) < 1e-3
+
+
+def test_generate_proposals_small_boxes_do_not_suppress():
+    # a higher-scoring sub-min_size box overlapping a valid one must be
+    # filtered BEFORE suppression, not drag the valid box down with it
+    sc = np.array([0.99, 0.5], np.float32).reshape(1, 2, 1, 1)
+    bd = np.zeros((1, 8, 1, 1), np.float32)
+    anch = np.array([[10, 10, 12, 12], [10, 10, 40, 40]], np.float32)
+    va = np.ones((2, 4), np.float32)
+    r, p, n = V.generate_proposals(
+        t(sc), t(bd), t(np.array([[64, 64]], np.float32)), t(anch),
+        t(va), min_size=10.0, nms_thresh=0.5, return_rois_num=True)
+    assert int(n.numpy()[0]) == 1
+    np.testing.assert_allclose(np.asarray(r.numpy())[0],
+                               [10, 10, 40, 40], atol=1)
+
+
+def test_nms_top_k_is_per_category():
+    boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                      [40, 40, 50, 50], [60, 60, 70, 70]], np.float32)
+    scores = np.array([0.9, 0.8, 0.3, 0.2], np.float32)
+    cats = np.array([0, 0, 1, 1])
+    got = V.nms(t(boxes), 0.5, t(scores), t(cats, "int64"), [0, 1],
+                top_k=1).numpy()
+    # one winner PER category, not the 2 globally-highest
+    assert set(np.asarray(got).tolist()) == {0, 2}
+
+
 def test_box_coder_roundtrip_and_yolo_prior_shapes():
     prior = np.array([[10, 10, 30, 40], [5, 5, 20, 25]], np.float32)
     var = [0.1, 0.1, 0.2, 0.2]
