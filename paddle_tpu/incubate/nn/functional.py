@@ -11,7 +11,8 @@ from ...ops.api import fused_linear_cross_entropy  # noqa: F401
 
 __all__ = ["fused_linear", "fused_linear_cross_entropy",
            "fused_multi_head_attention", "fused_feedforward",
-           "fused_rms_norm", "fused_layer_norm", "swiglu"]
+           "fused_rms_norm", "fused_layer_norm", "swiglu",
+           "fused_rotary_position_embedding"]
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight,
@@ -119,3 +120,69 @@ def swiglu(x, y=None):
     if y is None:
         x, y = P.split(x, 2, axis=-1)
     return F.silu(x) * y
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None,
+                                    cos=None, position_ids=None,
+                                    use_neox_rotary_style=True):
+    """incubate fused_rotary_position_embedding: rotate q/k(/v)
+    [B, S, H, D] by cos/sin [1, S, 1, D] (XLA fuses the mul/roll chain
+    — the 'fused' of the reference's CUDA kernel comes free here).
+    Neox style rotates halves; the non-neox style rotates interleaved
+    even/odd lanes."""
+    from ...models.llama import apply_rotary_pos_emb
+    from ... import ops as P
+    from ...tensor import to_tensor as _tt
+    import numpy as _np
+
+    if cos is None or sin is None:
+        d = q.shape[-1]
+        s = q.shape[1]
+        inv = 1.0 / (10000.0 ** (_np.arange(0, d, 2) / d))
+        t = _np.arange(s)[:, None] * inv[None, :]
+        emb = _np.concatenate([t, t], -1)          # [S, D] cat layout
+        cos = _tt(_np.cos(emb).astype("float32"))
+        sin = _tt(_np.sin(emb).astype("float32"))
+    else:
+        # paddle passes [1, S, 1, D]; the rope core wants [S, D]
+        if len(cos.shape) == 4:
+            cos = P.reshape(cos, [cos.shape[1], cos.shape[3]])
+            sin = P.reshape(sin, [sin.shape[1], sin.shape[3]])
+    if position_ids is not None:
+        # PER-ROW positions: gather [B, S, D] angles and rotate inline
+        # (the shared rope core takes one [S, D] table for the batch)
+        cos_b = cos[position_ids]                  # [B, S, D]
+        sin_b = sin[position_ids]
+
+        def rope_rows(x):
+            def raw(xv, cv, sv):
+                import jax.numpy as jnp
+                if use_neox_rotary_style:
+                    h = xv.shape[-1] // 2
+                    rot = jnp.concatenate([-xv[..., h:], xv[..., :h]], -1)
+                else:
+                    h = cv.shape[-1] // 2
+                    cv = jnp.repeat(cv[..., :h], 2, axis=-1)
+                    sv = jnp.repeat(sv[..., :h], 2, axis=-1)
+                    even = xv[..., 0::2]
+                    odd = xv[..., 1::2]
+                    rot = jnp.stack([-odd, even], -1).reshape(xv.shape)
+                cf = cv[:, :, None, :].astype(jnp.float32)
+                sf = sv[:, :, None, :].astype(jnp.float32)
+                xf = xv.astype(jnp.float32)
+                return (xf * cf + rot.astype(jnp.float32) * sf).astype(
+                    xv.dtype)
+            from ...tensor import apply_op as _ap
+            return _ap(raw, x, cos_b, sin_b)
+
+        return tuple(None if x is None else rope_rows(x)
+                     for x in (q, k, v))
+    outs = []
+    for x in (q, k, v):
+        if x is None:
+            outs.append(None)
+            continue
+        a, _ = apply_rotary_pos_emb(
+            x, x, cos, sin, interleaved=not use_neox_rotary_style)
+        outs.append(a)
+    return tuple(outs)

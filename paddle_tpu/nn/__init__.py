@@ -1,5 +1,5 @@
 """paddle.nn surface."""
-from . import functional, initializer
+from . import functional, initializer, utils
 from .layer import Layer, functional_state
 from .common import *  # noqa: F401,F403
 from .container import LayerDict, LayerList, ParameterList, Sequential

@@ -93,6 +93,12 @@ class Conv3D(_ConvNd):
                         self.dilation, self.groups, self.data_format)
 
 
+def _spatial_dims(x, data_format):
+    """Input spatial extent under either layout (channel-last formats
+    end with 'C')."""
+    return x.shape[1:-1] if data_format.endswith("C") else x.shape[2:]
+
+
 def _output_padding_from_size(in_spatial, output_size, kernel, stride,
                               padding, dilation):
     """Resolve transpose-conv shape ambiguity: derive per-dim
@@ -130,9 +136,10 @@ class Conv2DTranspose(_ConvNd):
 
     def forward(self, x, output_size=None):
         op = self.output_padding if output_size is None else \
-            _output_padding_from_size(x.shape[2:], output_size,
-                                      self.kernel_size, self.stride,
-                                      self.padding, self.dilation)
+            _output_padding_from_size(
+                _spatial_dims(x, self.data_format), output_size,
+                self.kernel_size, self.stride, self.padding,
+                self.dilation)
         return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
                                   self.padding, op,
                                   self.dilation, self.groups,
@@ -260,9 +267,10 @@ class Conv1DTranspose(_ConvNd):
 
     def forward(self, x, output_size=None):
         op = self.output_padding if output_size is None else \
-            _output_padding_from_size(x.shape[2:], output_size,
-                                      self.kernel_size, self.stride,
-                                      self.padding, self.dilation)
+            _output_padding_from_size(
+                _spatial_dims(x, self.data_format), output_size,
+                self.kernel_size, self.stride, self.padding,
+                self.dilation)
         return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
                                   self.padding, op,
                                   self.dilation, self.groups,
@@ -281,9 +289,10 @@ class Conv3DTranspose(_ConvNd):
 
     def forward(self, x, output_size=None):
         op = self.output_padding if output_size is None else \
-            _output_padding_from_size(x.shape[2:], output_size,
-                                      self.kernel_size, self.stride,
-                                      self.padding, self.dilation)
+            _output_padding_from_size(
+                _spatial_dims(x, self.data_format), output_size,
+                self.kernel_size, self.stride, self.padding,
+                self.dilation)
         return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
                                   self.padding, op,
                                   self.dilation, self.groups,

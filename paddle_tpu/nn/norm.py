@@ -18,7 +18,8 @@ from ..tensor import Tensor
 
 __all__ = ["LayerNorm", "RMSNorm", "GroupNorm", "BatchNorm", "BatchNorm1D",
            "BatchNorm2D", "BatchNorm3D", "InstanceNorm1D", "InstanceNorm2D",
-           "SyncBatchNorm", "LocalResponseNorm", "SpectralNorm"]
+           "InstanceNorm3D", "SyncBatchNorm", "LocalResponseNorm",
+           "SpectralNorm"]
 
 
 class LayerNorm(Layer):
@@ -128,8 +129,13 @@ class _BatchNormBase(Layer):
             x, self._mean, self._variance, self.weight, self.bias,
             training=training, momentum=self.momentum, epsilon=self.epsilon,
             data_format=self.data_format)
-        if training:
-            # running-stat update outside the tape
+        from ..static.graph import StaticVariable
+        if training and not isinstance(new_rm, StaticVariable):
+            # running-stat update outside the tape.  Under static
+            # recording the stats are symbolic — a buffer can't hold a
+            # StaticVariable, so recording leaves the running stats
+            # untouched (the replay normalizes by batch stats, which is
+            # what training-mode BN computes anyway).
             self._mean._value = new_rm.value if isinstance(new_rm, Tensor) \
                 else new_rm
             self._variance._value = new_rv.value if isinstance(new_rv, Tensor) \
@@ -191,6 +197,14 @@ class InstanceNorm1D(Layer):
 class InstanceNorm2D(InstanceNorm1D):
     def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
                  weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr, data_format, name)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
                  name=None):
         super().__init__(num_features, epsilon, momentum, weight_attr,
                          bias_attr, data_format, name)

@@ -1,0 +1,164 @@
+"""paddle.nn.utils: parameter-surgery helpers.
+
+Reference parity: python/paddle/nn/utils (weight_norm / spectral_norm
+reparameterizations via forward-pre-hooks, clip_grad_* eager helpers,
+parameters_to_vector round-trip)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.errors import enforce
+from ..tensor import Parameter, Tensor, to_tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| recomputed every
+    forward (a forward-pre-hook, like the reference)."""
+    w = getattr(layer, name)
+    enforce(isinstance(w, Tensor), f"layer has no tensor {name!r}")
+    g = Parameter(_norm_except(w.value, dim))
+    v = Parameter(w.value)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, *_):
+        # tape ops, not raw jnp: grads must flow back to g and v
+        from .. import ops as P
+        gg = getattr(lyr, name + "_g")
+        vv = getattr(lyr, name + "_v")
+        if dim is None:
+            norm = P.sqrt(P.sum(P.square(vv)))
+        else:
+            axes = [i for i in range(len(vv.shape)) if i != dim]
+            norm = P.sqrt(P.sum(P.square(vv), axis=axes, keepdim=True))
+        object.__setattr__(lyr, name, P.multiply(P.divide(vv, norm), gg))
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _recompute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle, nm, dim = layer._weight_norm_hook
+    enforce(nm == name, f"weight_norm was applied to {nm!r}, not "
+            f"{name!r}")
+    handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = Parameter(v.value / _norm_except(v.value, dim) * g.value)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, w)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Divide ``layer.<name>`` by its largest singular value, estimated
+    by power iteration refreshed every forward (reference semantics;
+    the u vector persists as a buffer)."""
+    w = getattr(layer, name)
+    mat = np.asarray(w.numpy())
+    if dim != 0:
+        mat = np.moveaxis(mat, dim, 0)
+    mat = mat.reshape(mat.shape[0], -1)
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(mat.shape[0]).astype(np.float32)
+    layer._sn_u = u0 / (np.linalg.norm(u0) + eps)
+    orig = Parameter(w.value)
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, *_):
+        from .. import ops as P
+        worig = getattr(lyr, name + "_orig")
+        m = worig.value
+        if dim != 0:
+            m = jnp.moveaxis(m, dim, 0)
+        m2 = m.reshape(m.shape[0], -1)
+        u = jnp.asarray(lyr._sn_u)
+        # power iteration on detached values (u/v are constants wrt
+        # grad, the reference's convention); v is computed from the
+        # stored u even at 0 iterations
+        v = m2.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        for _ in range(n_power_iterations):
+            u = m2 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+            v = m2.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+        lyr._sn_u = np.asarray(u)
+        sigma = float(u @ m2 @ v)
+        # tape op so grads flow to the orig parameter
+        object.__setattr__(lyr, name, P.scale(worig, 1.0 / sigma))
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hook = (handle, name)
+    _recompute(layer)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over ``parameters`` (eager
+    path; compiled training uses ClipGradByGlobalNorm inside the jitted
+    optimizer update instead)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    ps = [p for p in parameters if p._grad is not None]
+    if not ps:
+        return to_tensor(0.0)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad))
+                                   for p in ps]))
+    else:
+        total = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(p._grad) ** norm_type) for p in ps])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite:
+        enforce(bool(jnp.isfinite(total)),
+                "gradient norm is non-finite")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in ps:
+        p._grad = p._grad * scale
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters):
+    return Tensor(jnp.concatenate(
+        [jnp.ravel(p.value) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    v = vec.value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        p.set_value(v[off:off + n].reshape(p.value.shape)
+                    .astype(p.value.dtype))
+        off += n

@@ -570,6 +570,12 @@ class LBFGS(Optimizer):
         loss = closure()
         grads = [p._grad if p._grad is not None
                  else jnp.zeros_like(p.value) for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip.transform(grads)
+        if self._weight_decay:
+            grads = [g + self._weight_decay
+                     * (jnp.sign(p.value) if self._l1_decay else p.value)
+                     for g, p in zip(grads, params)]
         return float(loss.numpy()), self._flat(grads)
 
     def step(self, closure=None):

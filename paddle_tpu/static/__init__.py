@@ -19,8 +19,9 @@ from .graph import (Executor, InputSpec, Program, StaticVariable, data,
                     default_main_program, default_startup_program,
                     program_guard, scope_guard, global_scope, name_scope,
                     enable_static, disable_static, in_static_mode)
+from . import nn
 
-__all__ = ["Program", "StaticVariable", "Executor", "data",
+__all__ = ["Program", "StaticVariable", "Executor", "data", "nn",
            "program_guard", "default_main_program",
            "default_startup_program", "scope_guard", "global_scope",
            "name_scope", "InputSpec", "enable_static", "disable_static",

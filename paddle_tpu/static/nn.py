@@ -1,0 +1,167 @@
+"""paddle.static.nn — static-graph layer helpers.
+
+Reference parity: python/paddle/static/nn (fc, conv2d, batch_norm,
+embedding, ... created inside a Program).  TPU-native: each helper
+instantiates the corresponding ``nn`` Layer and applies it to the
+static variable; the layer's parameters are captured LIVE by the
+Program replay (static/graph.py ``_captured_tensors``), so
+``Executor.run`` sees optimizer updates — the reference's
+scope-variable mechanics without a scope."""
+from __future__ import annotations
+
+from .. import nn as _nn
+
+__all__ = ["fc", "conv2d", "conv2d_transpose", "conv3d", "batch_norm",
+           "layer_norm", "group_norm", "instance_norm", "embedding",
+           "prelu", "dropout", "spectral_norm"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..common.errors import enforce
+    enforce(num_flatten_dims == 1,
+            "static.nn.fc supports num_flatten_dims=1")
+    layer = _nn.Linear(x.shape[-1], size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    layer = _nn.Conv2D(input.shape[1], num_filters, filter_size,
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1,
+                     padding=0, output_padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     name=None, data_format="NCHW"):
+    layer = _nn.Conv2DTranspose(
+        input.shape[1], num_filters, filter_size, stride=stride,
+        padding=padding, output_padding=output_padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    layer = _nn.Conv3D(input.shape[1], num_filters, filter_size,
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    layer = _nn.BatchNorm2D(input.shape[1], momentum=momentum,
+                            epsilon=epsilon, weight_attr=param_attr,
+                            bias_attr=bias_attr)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..common.errors import enforce
+    enforce(begin_norm_axis == len(input.shape) - 1
+            or begin_norm_axis == -1,
+            "static.nn.layer_norm normalizes the last axis here")
+    layer = _nn.LayerNorm(input.shape[-1], epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    layer = _nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                          weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    cls = {4: _nn.InstanceNorm2D, 5: _nn.InstanceNorm3D}.get(
+        len(input.shape), _nn.InstanceNorm1D)
+    layer = cls(input.shape[1], epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    num = 1 if mode == "all" else x.shape[1]
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
+    return layer(x)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    return _nn.functional.dropout(x, p=dropout_prob,
+                                  training=not is_test)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, epsilon=1e-12,
+                  name=None):
+    """Normalize a CONCRETE weight tensor by its top singular value
+    (the reference's static op takes the weight parameter directly)."""
+    import numpy as np
+
+    from .. import ops as P
+    from ..common.errors import enforce
+
+    enforce(hasattr(weight, "numpy"),
+            "static.nn.spectral_norm takes the (concrete) weight "
+            "parameter, not a recorded static variable")
+    mv = np.asarray(weight.numpy())
+    if dim != 0:
+        mv = np.moveaxis(mv, dim, 0)
+    mv = mv.reshape(mv.shape[0], -1)
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(mv.shape[0]).astype(np.float32)
+    u /= np.linalg.norm(u) + epsilon
+    v = mv.T @ u
+    v = v / (np.linalg.norm(v) + epsilon)     # defined even at 0 iters
+    for _ in range(power_iters):
+        u = mv @ v
+        u = u / (np.linalg.norm(u) + epsilon)
+        v = mv.T @ u
+        v = v / (np.linalg.norm(v) + epsilon)
+    sigma = float(u @ mv @ v)
+    return P.scale(weight, 1.0 / sigma)

@@ -195,3 +195,245 @@ def resnet50(pretrained=False, **kwargs):
 
 def resnet101(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# round-5 zoo fill: AlexNet, SqueezeNet, MobileNetV1/V2, ShuffleNetV2
+# ---------------------------------------------------------------------------
+
+class AlexNet(Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        from ..nn import Dropout as _Dropout
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Flatten(), _Dropout(0.5), Linear(256 * 36, 4096), ReLU(),
+            _Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.avgpool(self.features(x)))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+        self.e1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.e3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        from .. import ops as P
+        s = self.squeeze(x)
+        return P.concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version: str = "1.1", num_classes: int = 1000):
+        super().__init__()
+        from ..common.errors import enforce
+        from ..nn import Dropout as _Dropout
+        enforce(version in ("1.0", "1.1"),
+                f"SqueezeNet version must be '1.0' or '1.1', "
+                f"got {version!r}")
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2),
+                _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            _Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D(1), Flatten())
+
+    def forward(self, x):
+        return self.classifier(self.features(x))
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act=True):
+    layers = [Conv2D(cin, cout, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False),
+              BatchNorm2D(cout)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] \
+            + [(512, 512, 1)] * 5 + [(512, 1024, 2), (1024, 1024, 1)]
+        blocks = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        for cin, cout, s in cfg:
+            blocks.append(Sequential(
+                _conv_bn(c(cin), c(cin), 3, stride=s, padding=1,
+                         groups=c(cin)),                   # depthwise
+                _conv_bn(c(cin), c(cout), 1)))             # pointwise
+        self.features = Sequential(*blocks)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Sequential(Flatten(), Linear(c(1024), num_classes))
+
+    def forward(self, x):
+        return self.fc(self.pool(self.features(x)))
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = int(round(cin * expand))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(cin, hidden, 1))
+        layers += [_conv_bn(hidden, hidden, 3, stride=stride, padding=1,
+                            groups=hidden),
+                   _conv_bn(hidden, cout, 1, act=False)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        cin = c(32)
+        blocks = [_conv_bn(3, cin, 3, stride=2, padding=1)]
+        for expand, ch, n, s in cfg:
+            for i in range(n):
+                blocks.append(_InvertedResidual(
+                    cin, c(ch), s if i == 0 else 1, expand))
+                cin = c(ch)
+        last = max(1280, int(1280 * scale))
+        blocks.append(_conv_bn(cin, last, 1))
+        self.features = Sequential(*blocks)
+        self.pool = AdaptiveAvgPool2D(1)
+        from ..nn import Dropout as _Dropout
+        self.classifier = Sequential(Flatten(), _Dropout(0.2),
+                                     Linear(last, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.pool(self.features(x)))
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        from ..nn import ChannelShuffle
+        branch = cout // 2
+        self.stride = stride
+        if stride == 2:
+            self.branch1 = Sequential(
+                _conv_bn(cin, cin, 3, stride=2, padding=1, groups=cin,
+                         act=False),
+                _conv_bn(cin, branch, 1))
+            right_in = cin
+        else:
+            self.branch1 = None
+            right_in = cin // 2
+        self.branch2 = Sequential(
+            _conv_bn(right_in, branch, 1),
+            _conv_bn(branch, branch, 3, stride=stride, padding=1,
+                     groups=branch, act=False),
+            _conv_bn(branch, branch, 1))
+        self.shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        from .. import ops as P
+        if self.stride == 2:
+            out = P.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = P.concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024],
+                     2.0: [244, 488, 976, 2048]}[scale]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1)
+        self.pool1 = MaxPool2D(3, 2, padding=1)
+        cin = 24
+        stages = []
+        for ch, repeat in zip(stage_out[:3], (4, 8, 4)):
+            units = [_ShuffleUnit(cin, ch, 2)]
+            units += [_ShuffleUnit(ch, ch, 1) for _ in range(repeat - 1)]
+            stages.append(Sequential(*units))
+            cin = ch
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(cin, stage_out[3], 1)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Sequential(Flatten(), Linear(stage_out[3], num_classes))
+
+    def forward(self, x):
+        x = self.pool1(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        return self.fc(self.pool(x))
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+__all__ += ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+            "squeezenet1_1", "MobileNetV1", "mobilenet_v1",
+            "MobileNetV2", "mobilenet_v2", "ShuffleNetV2",
+            "shufflenet_v2_x1_0"]

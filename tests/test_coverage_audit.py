@@ -45,15 +45,16 @@ def test_coverage_claims_match_reality():
 
 
 def test_coverage_test_count_is_current():
-    m = re.search(r"(\d+) test functions", COV)
-    assert m, "COVERAGE.md must state the test-function count"
-    claimed = int(m.group(1))
+    claims = [int(m) for m in re.findall(r"(\d+) test functions", COV)]
+    assert claims, "COVERAGE.md must state the test-function count"
     actual = 0
     for f in (REPO / "tests").glob("test_*.py"):
         actual += len(re.findall(r"^\s*def test_", f.read_text(),
                                  re.MULTILINE))
-    assert claimed == actual, (
-        f"COVERAGE.md claims {claimed} test functions, tests/ has "
+    # EVERY occurrence must match — a stale row is exactly the rot
+    # class this audit exists to stop
+    assert all(c == actual for c in claims), (
+        f"COVERAGE.md claims {claims} test functions, tests/ has "
         f"{actual} — regenerate the audit")
 
 

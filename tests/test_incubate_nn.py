@@ -124,3 +124,46 @@ def test_fused_feedforward_rejects_bogus_activation():
     with pytest.raises(Exception):
         iF.fused_feedforward(x, w1, w2, activation="dropout",
                              dropout1_rate=0.0, dropout2_rate=0.0)
+
+
+def test_fused_rotary_position_embedding():
+    """Matches the llama rope core; positions gather; v passthrough."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.models.llama import apply_rotary_pos_emb
+    rng = np.random.default_rng(0)
+    q = paddle.to_tensor(rng.standard_normal((2, 6, 4, 8))
+                         .astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((2, 6, 4, 8))
+                         .astype(np.float32))
+    oq, ok, ov = IF.fused_rotary_position_embedding(q, k)
+    assert ov is None
+    d, s = 8, 6
+    inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+    t_ = np.arange(s)[:, None] * inv[None, :]
+    emb = np.concatenate([t_, t_], -1).astype(np.float32)
+    rq, rk = apply_rotary_pos_emb(q, k, paddle.to_tensor(np.cos(emb)),
+                                  paddle.to_tensor(np.sin(emb)))
+    np.testing.assert_allclose(np.asarray(oq.numpy()),
+                               np.asarray(rq.numpy()), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ok.numpy()),
+                               np.asarray(rk.numpy()), atol=1e-5)
+    # PER-ROW position_ids: each batch row rotates with its own angles
+    q2 = paddle.to_tensor(rng.standard_normal((2, 4, 2, 8))
+                          .astype(np.float32))
+    emb8 = np.concatenate([np.arange(8)[:, None] * inv[None, :]] * 2,
+                          -1).astype(np.float32)
+    pos = paddle.to_tensor(np.array([[0, 1, 2, 3], [4, 5, 6, 7]]),
+                           "int64")
+    oq2, _, _ = IF.fused_rotary_position_embedding(
+        q2, sin=paddle.to_tensor(np.sin(emb8)[None, :, None, :]),
+        cos=paddle.to_tensor(np.cos(emb8)[None, :, None, :]),
+        position_ids=pos)
+    refs = []
+    for b, rows in enumerate([[0, 1, 2, 3], [4, 5, 6, 7]]):
+        rq2, _ = apply_rotary_pos_emb(
+            q2[b:b + 1], q2[b:b + 1],
+            paddle.to_tensor(np.cos(emb8)[rows]),
+            paddle.to_tensor(np.sin(emb8)[rows]))
+        refs.append(np.asarray(rq2.numpy()))
+    np.testing.assert_allclose(np.asarray(oq2.numpy()),
+                               np.concatenate(refs, 0), atol=1e-5)

@@ -1,0 +1,152 @@
+"""Round-5: paddle.nn.utils (weight/spectral norm reparameterizations,
+grad clipping, parameter vectorization) and paddle.static.nn helpers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor import Parameter
+
+t = paddle.to_tensor
+rng = np.random.default_rng(0)
+
+
+def test_weight_norm_matches_torch_and_flows_grads():
+    torch = pytest.importorskip("torch")
+    lin = nn.Linear(4, 3)
+    w0 = np.asarray(lin.weight.numpy())           # [in, out]
+    nn.utils.weight_norm(lin, "weight", dim=1)
+    x = t(rng.standard_normal((2, 4)).astype(np.float32))
+    out = lin(x)
+    tl = torch.nn.Linear(4, 3, bias=False)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(w0.T))
+    tl = torch.nn.utils.weight_norm(tl, "weight", dim=0)
+    ref = tl(torch.tensor(np.asarray(x.numpy()))).detach().numpy() \
+        + np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, atol=1e-5)
+
+    out.sum().backward()
+    assert lin.weight_g._grad is not None
+    assert lin.weight_v._grad is not None
+
+    nn.utils.remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(np.asarray(lin(x).numpy()),
+                               np.asarray(out.numpy()), atol=1e-6)
+    assert "weight" in dict(lin.named_parameters())
+
+
+def test_spectral_norm_unit_top_singular_value():
+    lin = nn.Linear(6, 5)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+    lin(t(rng.standard_normal((2, 6)).astype(np.float32)))
+    sv = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                       compute_uv=False)
+    assert abs(sv[0] - 1.0) < 1e-3
+
+
+def test_clip_grad_helpers_and_vectorize():
+    import jax.numpy as jnp
+    p = Parameter(jnp.ones(4, jnp.float32))
+    p._grad = jnp.full((4,), 10.0)
+    total = nn.utils.clip_grad_norm_([p], 1.0)
+    assert abs(float(total.numpy()) - 20.0) < 1e-4
+    assert abs(np.linalg.norm(np.asarray(p._grad)) - 1.0) < 1e-4
+
+    p._grad = jnp.asarray([-5.0, 0.2, 7.0, -0.1])
+    nn.utils.clip_grad_value_([p], 0.5)
+    assert np.abs(np.asarray(p._grad)).max() <= 0.5
+
+    ps = [Parameter(jnp.asarray(rng.standard_normal((2, 3))
+                                .astype(np.float32))),
+          Parameter(jnp.asarray(rng.standard_normal((4,))
+                                .astype(np.float32)))]
+    vec = nn.utils.parameters_to_vector(ps)
+    assert tuple(vec.shape) == (10,)
+    nn.utils.vector_to_parameters(vec * 0 + 1.0, ps)
+    assert float(np.asarray(ps[0].value).sum()) == 6.0
+    assert float(np.asarray(ps[1].value).sum()) == 4.0
+
+
+def test_spectral_norm_zero_power_iterations():
+    lin = nn.Linear(6, 5)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=0)
+    out = lin(t(rng.standard_normal((2, 6)).astype(np.float32)))
+    assert np.isfinite(np.asarray(out.numpy())).all()
+    w = paddle.static.nn.spectral_norm(nn.Linear(6, 5).weight,
+                                       power_iters=0)
+    assert np.isfinite(np.asarray(w.numpy())).all()
+
+
+def test_lbfgs_applies_weight_decay():
+    import jax.numpy as jnp
+    import paddle_tpu.optimizer as opt
+
+    def run(wd):
+        w = Parameter(jnp.asarray(np.array([2.0, -1.0], np.float32)))
+        lb = opt.LBFGS(learning_rate=0.1, max_iter=3, parameters=[w],
+                       weight_decay=wd)
+
+        def closure():
+            loss = (w * w).sum()
+            loss.backward()
+            return loss
+
+        lb.step(closure)
+        return np.asarray(w.value)
+
+    assert not np.allclose(run(0.0), run(0.5))
+
+
+def test_conv_transpose_output_size_channel_last():
+    l1 = nn.Conv1DTranspose(4, 3, 3, stride=2, data_format="NLC")
+    x = t(rng.standard_normal((1, 5, 4)).astype(np.float32))
+    assert tuple(l1(x, output_size=[12]).shape) == (1, 12, 3)
+
+
+def test_instance_norm_3d():
+    x = rng.standard_normal((2, 4, 3, 3, 3)).astype(np.float32)
+    out = np.asarray(nn.InstanceNorm3D(4)(t(x)).numpy())
+    # per-(N, C) volume normalized to zero mean / unit var
+    flat = out.reshape(2, 4, -1)
+    np.testing.assert_allclose(flat.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(flat.std(-1), 1.0, atol=1e-2)
+
+
+def test_static_nn_helpers_run_and_train_params_update():
+    S = paddle.static
+    paddle.enable_static()
+    try:
+        main = S.Program()
+        start = S.Program()
+        with S.program_guard(main, start):
+            x = S.data("x", [4, 8])
+            h = S.nn.fc(x, 16, activation="relu")
+            img = S.data("img", [2, 3, 8, 8])
+            c = S.nn.conv2d(img, 6, 3, padding=1, act="relu")
+            b = S.nn.batch_norm(c)
+            e = S.nn.embedding(S.data("ids", [4], dtype="int64"),
+                               [10, 5])
+            ln = S.nn.layer_norm(h)
+            gn = S.nn.group_norm(c, 3)
+            io = S.nn.instance_norm(c)
+            pr = S.nn.prelu(c)
+        exe = S.Executor()
+        feed = {"x": rng.standard_normal((4, 8)).astype(np.float32),
+                "img": rng.standard_normal((2, 3, 8, 8))
+                .astype(np.float32),
+                "ids": np.arange(4)}
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[h, b, e, ln, gn, io, pr])
+        shapes = [tuple(np.asarray(o).shape) for o in outs]
+        assert shapes == [(4, 16), (2, 6, 8, 8), (4, 5), (4, 16),
+                          (2, 6, 8, 8), (2, 6, 8, 8), (2, 6, 8, 8)]
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_spectral_norm_concrete():
+    lin = nn.Linear(6, 5)
+    wsn = paddle.static.nn.spectral_norm(lin.weight, power_iters=30)
+    sv = np.linalg.svd(np.asarray(wsn.numpy()), compute_uv=False)
+    assert abs(sv[0] - 1.0) < 1e-3

@@ -259,3 +259,42 @@ class TestReviewRegressions:
         arr[0] = 1.0                       # "B" channel
         out = T.normalize(arr, [0.0], [1.0], to_rgb=True)
         assert out[2].sum() == 4.0 and out[0].sum() == 0.0
+
+
+class TestRound5ModelZoo:
+    """AlexNet / SqueezeNet / MobileNetV1+V2 / ShuffleNetV2 forward
+    shapes + one compiled train step on the lightest (mobilenet_v1)."""
+
+    def test_zoo_forward_shapes(self):
+        paddle.seed(0)
+        from paddle_tpu.vision import models as M
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 64, 64)).astype(np.float32))
+        zoo = [M.alexnet(num_classes=5),
+               M.squeezenet1_1(num_classes=5),
+               M.mobilenet_v1(scale=0.25, num_classes=5),
+               M.mobilenet_v2(scale=0.25, num_classes=5),
+               M.shufflenet_v2_x1_0(num_classes=5)]
+        for m in zoo:
+            m.eval()
+            assert tuple(m(x).shape) == (1, 5), type(m).__name__
+
+    def test_mobilenet_v1_trains(self):
+        paddle.seed(0)
+        from paddle_tpu.vision import models as M
+        model = M.mobilenet_v1(scale=0.25, num_classes=4)
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=model.parameters())
+        crit = nn.CrossEntropyLoss()
+        from paddle_tpu.jit.train import CompiledTrainStep
+        step = CompiledTrainStep(
+            model, lambda m, b: crit(m(b["x"]), b["y"]), opt)
+        rng = np.random.default_rng(1)
+        xb = rng.normal(size=(4, 3, 32, 32)).astype(np.float32)
+        yb = rng.integers(0, 4, size=(4,))
+        losses = [float(np.asarray(step({"x": xb, "y": yb})))
+                  for _ in range(10)]
+        # BN stats on a 4-sample batch make per-step loss noisy: assert
+        # the trend, not monotonicity
+        assert all(np.isfinite(losses))
+        assert min(losses[5:]) < losses[0]
