@@ -1,2 +1,3 @@
 from .tape import backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
 from .py_layer import PyLayer, PyLayerContext, once_differentiable
+from .functional import hessian, jacobian, jvp, vjp

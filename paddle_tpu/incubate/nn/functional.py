@@ -130,19 +130,16 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None,
     — the 'fused' of the reference's CUDA kernel comes free here).
     Neox style rotates halves; the non-neox style rotates interleaved
     even/odd lanes."""
-    from ...models.llama import apply_rotary_pos_emb
+    from ...models.llama import _rope_cos_sin, apply_rotary_pos_emb
     from ... import ops as P
     from ...tensor import to_tensor as _tt
     import numpy as _np
 
     if cos is None or sin is None:
-        d = q.shape[-1]
-        s = q.shape[1]
-        inv = 1.0 / (10000.0 ** (_np.arange(0, d, 2) / d))
-        t = _np.arange(s)[:, None] * inv[None, :]
-        emb = _np.concatenate([t, t], -1)          # [S, D] cat layout
-        cos = _tt(_np.cos(emb).astype("float32"))
-        sin = _tt(_np.sin(emb).astype("float32"))
+        # the llama rope table (single source for layout/theta handling)
+        emb = _rope_cos_sin(q.shape[1], q.shape[-1], 10000.0)
+        cos = _tt(_np.cos(emb))
+        sin = _tt(_np.sin(emb))
     else:
         # paddle passes [1, S, 1, D]; the rope core wants [S, D]
         if len(cos.shape) == 4:
@@ -177,12 +174,23 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None,
 
         return tuple(None if x is None else rope_rows(x)
                      for x in (q, k, v))
-    outs = []
-    for x in (q, k, v):
-        if x is None:
-            outs.append(None)
-            continue
-        a, _ = apply_rotary_pos_emb(
-            x, x, cos, sin, interleaved=not use_neox_rotary_style)
-        outs.append(a)
-    return tuple(outs)
+    # rotate in PAIRS: apply_rotary_pos_emb does two tensors per call,
+    # so a (q, k, v) batch costs 2 calls, not 3 doubled ones
+    present = [i for i, x in enumerate((q, k, v)) if x is not None]
+    tensors = [q, k, v]
+    out = [None, None, None]
+    i = 0
+    while i < len(present):
+        ia = present[i]
+        if i + 1 < len(present):
+            ib = present[i + 1]
+            out[ia], out[ib] = apply_rotary_pos_emb(
+                tensors[ia], tensors[ib], cos, sin,
+                interleaved=not use_neox_rotary_style)
+            i += 2
+        else:
+            out[ia], _ = apply_rotary_pos_emb(
+                tensors[ia], tensors[ia], cos, sin,
+                interleaved=not use_neox_rotary_style)
+            i += 1
+    return tuple(out)

@@ -87,7 +87,9 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         del layer._parameters[name]
 
     def _recompute(lyr, *_):
-        from .. import ops as P
+        import jax as _jax
+
+        from ..tensor import Tensor, apply_op
         worig = getattr(lyr, name + "_orig")
         m = worig.value
         if dim != 0:
@@ -96,7 +98,7 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         u = jnp.asarray(lyr._sn_u)
         # power iteration on detached values (u/v are constants wrt
         # grad, the reference's convention); v is computed from the
-        # stored u even at 0 iterations
+        # stored u even at 0 iterations.  All jnp ops: trace-safe.
         v = m2.T @ u
         v = v / (jnp.linalg.norm(v) + eps)
         for _ in range(n_power_iterations):
@@ -104,10 +106,13 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             u = u / (jnp.linalg.norm(u) + eps)
             v = m2.T @ u
             v = v / (jnp.linalg.norm(v) + eps)
-        lyr._sn_u = np.asarray(u)
-        sigma = float(u @ m2 @ v)
-        # tape op so grads flow to the orig parameter
-        object.__setattr__(lyr, name, P.scale(worig, 1.0 / sigma))
+        if not isinstance(u, _jax.core.Tracer):
+            lyr._sn_u = np.asarray(u)     # persist only when concrete
+        sigma = u @ m2 @ v
+        # tape op (grads flow to orig); sigma may be a tracer
+        object.__setattr__(
+            lyr, name,
+            apply_op(lambda w_, s_: w_ / s_, worig, Tensor(sigma)))
 
     handle = layer.register_forward_pre_hook(_recompute)
     layer._spectral_norm_hook = (handle, name)

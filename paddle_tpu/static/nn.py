@@ -16,12 +16,26 @@ __all__ = ["fc", "conv2d", "conv2d_transpose", "conv3d", "batch_norm",
            "prelu", "dropout", "spectral_norm"]
 
 
+def _channels(x, data_format):
+    """Channel count under either layout (channel-last formats end
+    with 'C')."""
+    return x.shape[-1] if data_format.endswith("C") else x.shape[1]
+
+
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
+    """paddle contract: trailing dims from ``num_flatten_dims`` on are
+    flattened into the Linear's input features."""
+    import numpy as np
+
+    from .. import ops as P
     from ..common.errors import enforce
-    enforce(num_flatten_dims == 1,
-            "static.nn.fc supports num_flatten_dims=1")
-    layer = _nn.Linear(x.shape[-1], size, weight_attr=weight_attr,
+    enforce(1 <= num_flatten_dims < len(x.shape),
+            f"num_flatten_dims must be in [1, {len(x.shape) - 1})")
+    in_features = int(np.prod(x.shape[num_flatten_dims:]))
+    if num_flatten_dims != len(x.shape) - 1:
+        x = P.reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
+    layer = _nn.Linear(in_features, size, weight_attr=weight_attr,
                        bias_attr=bias_attr)
     out = layer(x)
     if activation:
@@ -32,10 +46,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 def conv2d(input, num_filters, filter_size, stride=1, padding=0,
            dilation=1, groups=1, param_attr=None, bias_attr=None,
            act=None, name=None, data_format="NCHW"):
-    layer = _nn.Conv2D(input.shape[1], num_filters, filter_size,
-                       stride=stride, padding=padding, dilation=dilation,
-                       groups=groups, weight_attr=param_attr,
-                       bias_attr=bias_attr, data_format=data_format)
+    layer = _nn.Conv2D(_channels(input, data_format), num_filters,
+                       filter_size, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
     out = layer(input)
     if act:
         out = getattr(_nn.functional, act)(out)
@@ -47,8 +62,8 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1,
                      param_attr=None, bias_attr=None, act=None,
                      name=None, data_format="NCHW"):
     layer = _nn.Conv2DTranspose(
-        input.shape[1], num_filters, filter_size, stride=stride,
-        padding=padding, output_padding=output_padding,
+        _channels(input, data_format), num_filters, filter_size,
+        stride=stride, padding=padding, output_padding=output_padding,
         dilation=dilation, groups=groups, weight_attr=param_attr,
         bias_attr=bias_attr, data_format=data_format)
     out = layer(input)
@@ -60,10 +75,11 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1,
 def conv3d(input, num_filters, filter_size, stride=1, padding=0,
            dilation=1, groups=1, param_attr=None, bias_attr=None,
            act=None, name=None, data_format="NCDHW"):
-    layer = _nn.Conv3D(input.shape[1], num_filters, filter_size,
-                       stride=stride, padding=padding, dilation=dilation,
-                       groups=groups, weight_attr=param_attr,
-                       bias_attr=bias_attr, data_format=data_format)
+    layer = _nn.Conv3D(_channels(input, data_format), num_filters,
+                       filter_size, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
     out = layer(input)
     if act:
         out = getattr(_nn.functional, act)(out)
@@ -73,9 +89,10 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                is_test=False, name=None):
-    layer = _nn.BatchNorm2D(input.shape[1], momentum=momentum,
-                            epsilon=epsilon, weight_attr=param_attr,
-                            bias_attr=bias_attr)
+    layer = _nn.BatchNorm2D(_channels(input, data_layout),
+                            momentum=momentum, epsilon=epsilon,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_layout)
     if is_test:
         layer.eval()
     out = layer(input)
@@ -102,6 +119,9 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None,
                bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..common.errors import enforce
+    enforce(data_layout == "NCHW",
+            "static.nn.group_norm supports NCHW (channel-first) input")
     layer = _nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
                           weight_attr=param_attr, bias_attr=bias_attr)
     out = layer(input)
@@ -127,8 +147,9 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
 
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
-    num = 1 if mode == "all" else x.shape[1]
-    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
+    num = 1 if mode == "all" else _channels(x, data_format)
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                      data_format=data_format)
     return layer(x)
 
 

@@ -16,6 +16,8 @@ __all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
            "RandomVerticalFlip", "RandomResizedCrop", "Pad", "Grayscale",
            "RandomRotation", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter",
+           "RandomErasing", "GaussianBlur",
            "Transpose", "to_tensor", "normalize", "resize", "hflip",
            "vflip", "crop", "center_crop"]
 
@@ -414,3 +416,157 @@ class Transpose(BaseTransform):
 
     def _apply_image(self, img):
         return np.transpose(_to_np(img), self.order)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        raw = _to_np(img)
+        arr = raw.astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = arr @ np.array([0.299, 0.587, 0.114], np.float32) \
+            if arr.shape[-1] == 3 else arr[..., 0]
+        out = arr * f + gray[..., None] * (1 - f)
+        if raw.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(raw.dtype)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        raw = _to_np(img)
+        if raw.shape[-1] != 3:
+            return raw
+        f = random.uniform(-self.value, self.value)
+        arr = raw.astype(np.float32) / (255.0 if raw.dtype == np.uint8
+                                        else 1.0)
+        # vectorized RGB->HSV hue shift ->RGB
+        mx = arr.max(-1)
+        mn = arr.min(-1)
+        diff = mx - mn + 1e-12
+        r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+        h = np.where(mx == r, ((g - b) / diff) % 6,
+                     np.where(mx == g, (b - r) / diff + 2,
+                              (r - g) / diff + 4)) / 6.0
+        h = (h + f) % 1.0
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+        v = mx
+        i = np.floor(h * 6).astype(np.int32) % 6
+        frac = h * 6 - np.floor(h * 6)
+        p = v * (1 - s)
+        q = v * (1 - frac * s)
+        tt = v * (1 - (1 - frac) * s)
+        rgb = np.stack([
+            np.choose(i, [v, q, p, p, tt, v]),
+            np.choose(i, [tt, v, v, q, p, p]),
+            np.choose(i, [p, p, tt, v, v, q])], -1)
+        if raw.dtype == np.uint8:
+            return np.clip(rgb * 255.0, 0, 255).astype(np.uint8)
+        return rgb.astype(raw.dtype)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue, applied in random
+    order (the reference's semantics)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0, keys=None):
+        super().__init__(keys)
+        self._ts = []
+        if brightness:
+            self._ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self._ts.append(ContrastTransform(contrast))
+        if saturation:
+            self._ts.append(SaturationTransform(saturation))
+        if hue:
+            self._ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = list(self._ts)
+        random.shuffle(order)
+        for tr in order:
+            img = tr._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    """Erase a random rectangle (Zhong et al. 2020; reference
+    vision.transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_np(img).copy()
+        if random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                y = random.randint(0, h - eh)
+                x = random.randint(0, w - ew)
+                if self.value == "random":
+                    arr[y:y + eh, x:x + ew] = np.random.randint(
+                        0, 256, (eh, ew, arr.shape[-1]),
+                        dtype=np.uint8) if arr.dtype == np.uint8 else \
+                        np.random.standard_normal(
+                            (eh, ew, arr.shape[-1])).astype(arr.dtype)
+                else:
+                    arr[y:y + eh, x:x + ew] = self.value
+                break
+        return arr
+
+
+class GaussianBlur(BaseTransform):
+    def __init__(self, kernel_size=3, sigma=(0.1, 2.0), keys=None):
+        super().__init__(keys)
+        self.kernel_size = kernel_size if not isinstance(
+            kernel_size, numbers.Number) else (kernel_size, kernel_size)
+        self.sigma = sigma if not isinstance(sigma, numbers.Number) \
+            else (sigma, sigma)
+
+    def _apply_image(self, img):
+        raw = _to_np(img)
+        arr = raw.astype(np.float32)
+        sigma = random.uniform(*self.sigma)
+
+        def kern(k):
+            r = np.arange(k) - (k - 1) / 2.0
+            w = np.exp(-(r ** 2) / (2 * sigma ** 2))
+            return w / w.sum()
+
+        kh = kern(self.kernel_size[1])[:, None]   # rows
+        kw = kern(self.kernel_size[0])[None, :]   # cols
+        ph = self.kernel_size[1] // 2
+        pw = self.kernel_size[0] // 2
+        pad = np.pad(arr, ((ph, ph), (pw, pw), (0, 0)), mode="edge")
+        # separable blur via stride-tricked windows (host-side numpy)
+        out = np.zeros_like(arr)
+        for c in range(arr.shape[-1]):
+            tmp = np.apply_along_axis(
+                lambda m: np.convolve(m, kh[:, 0], mode="valid"), 0,
+                pad[:, :, c])
+            out[:, :, c] = np.apply_along_axis(
+                lambda m: np.convolve(m, kw[0], mode="valid"), 1, tmp)
+        if raw.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(raw.dtype)

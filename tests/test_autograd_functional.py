@@ -1,0 +1,80 @@
+"""paddle.autograd.{jacobian,hessian,jvp,vjp} — exact-AD functional
+transforms (jax jacrev/hessian/jvp/vjp under the paddle contract) —
+plus the round-5 vision transforms."""
+import random
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+
+
+def test_jacobian_and_hessian_closed_forms():
+    x = t(np.array([1.0, 2.0, 3.0], np.float32))
+    J = paddle.autograd.jacobian(lambda a: a * a, x)
+    np.testing.assert_allclose(np.asarray(J.numpy()),
+                               np.diag([2.0, 4.0, 6.0]), atol=1e-6)
+    H = paddle.autograd.hessian(lambda a: (a * a * a).sum(), x)
+    np.testing.assert_allclose(np.asarray(H.numpy()),
+                               np.diag([6.0, 12.0, 18.0]), atol=1e-5)
+
+
+def test_jacobian_multi_input_and_through_layer():
+    A = t(np.eye(2, dtype=np.float32))
+    b = t(np.ones((2,), np.float32))
+    J = paddle.autograd.jacobian(lambda a, v: a @ v, [A, b])
+    assert tuple(np.asarray(J[0].numpy()).shape) == (2, 2, 2)
+    np.testing.assert_allclose(np.asarray(J[1].numpy()),
+                               np.eye(2), atol=1e-6)
+
+    lin = paddle.nn.Linear(3, 2)
+    x = t(np.array([1.0, 2.0, 3.0], np.float32))
+    Jl = paddle.autograd.jacobian(lambda a: lin(a), x)
+    np.testing.assert_allclose(np.asarray(Jl.numpy()),
+                               np.asarray(lin.weight.numpy()).T,
+                               atol=1e-5)
+
+
+def test_vjp_jvp():
+    x = t(np.array([1.0, 2.0, 3.0], np.float32))
+    out, g = paddle.autograd.vjp(lambda a: a * a, x)
+    np.testing.assert_allclose(np.asarray(out.numpy()), [1, 4, 9],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g.numpy()), [2, 4, 6],
+                               atol=1e-6)
+    out, tan = paddle.autograd.jvp(
+        lambda a: a * a, x, t(np.array([1.0, 0.0, 1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(tan.numpy()), [2, 0, 6],
+                               atol=1e-6)
+
+
+def test_round5_transforms():
+    import scipy.ndimage as ndi
+
+    import paddle_tpu.vision.transforms as T
+    random.seed(0)
+    np.random.seed(0)
+    img = np.random.randint(0, 256, (16, 16, 3), np.uint8)
+
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert out.shape == (16, 16, 3) and out.dtype == np.uint8
+
+    out = T.RandomErasing(prob=1.0, value=0)(img)
+    assert (out == 0).any() and out.shape == img.shape
+    # prob=0 leaves the image untouched
+    np.testing.assert_array_equal(
+        T.RandomErasing(prob=0.0)(img), img)
+
+    blurred = T.GaussianBlur(5, sigma=(1.5, 1.5))(
+        img.astype(np.float32))
+    ref = np.stack([ndi.gaussian_filter(
+        img[..., c].astype(np.float32), 1.5, mode="nearest",
+        truncate=(5 // 2) / 1.5) for c in range(3)], -1)
+    np.testing.assert_allclose(blurred, ref, atol=1e-3)
+
+    # zero-strength jitter components are identities
+    np.testing.assert_array_equal(
+        T.SaturationTransform(0.0)(img), img)
+    assert np.abs(T.HueTransform(0.0)(img).astype(int)
+                  - img.astype(int)).max() <= 1

@@ -145,6 +145,45 @@ def test_static_nn_helpers_run_and_train_params_update():
         paddle.disable_static()
 
 
+def test_spectral_norm_composes_with_jit():
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit.train import CompiledTrainStep
+    lin = nn.Linear(6, 5)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=3)
+    opt = optimizer.SGD(0.01, parameters=lin.parameters())
+    crit = nn.MSELoss()
+    step = CompiledTrainStep(lin, lambda m, b: crit(m(b["x"]), b["y"]),
+                             opt)
+    xb = rng.standard_normal((4, 6)).astype(np.float32)
+    yb = rng.standard_normal((4, 5)).astype(np.float32)
+    losses = [float(np.asarray(step({"x": xb, "y": yb})))
+              for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_static_nn_fc_flattens_and_channel_last_conv():
+    S = paddle.static
+    paddle.enable_static()
+    try:
+        main = S.Program()
+        with S.program_guard(main, S.Program()):
+            x3 = S.data("x", [4, 2, 8])
+            h = S.nn.fc(x3, 16)              # [4, 2*8] -> [4, 16]
+            xn = S.data("img", [2, 8, 8, 3])
+            c = S.nn.conv2d(xn, 6, 3, data_format="NHWC")
+        outs = S.Executor().run(
+            main,
+            feed={"x": rng.standard_normal((4, 2, 8))
+                  .astype(np.float32),
+                  "img": rng.standard_normal((2, 8, 8, 3))
+                  .astype(np.float32)},
+            fetch_list=[h, c])
+        assert np.asarray(outs[0]).shape == (4, 16)
+        assert np.asarray(outs[1]).shape == (2, 6, 6, 6)  # NHWC out
+    finally:
+        paddle.disable_static()
+
+
 def test_static_nn_spectral_norm_concrete():
     lin = nn.Linear(6, 5)
     wsn = paddle.static.nn.spectral_norm(lin.weight, power_iters=30)
