@@ -38,9 +38,19 @@ def _as_jax_fn(func):
     return fn
 
 
+def _no_create_graph(create_graph):
+    from ..common.errors import enforce
+    enforce(not create_graph,
+            "create_graph=True is not supported on the eager tape: the "
+            "result would be detached. Differentiate through jacobians "
+            "inside a compiled step (jax transforms compose under jit) "
+            "instead")
+
+
 def jacobian(func, xs, create_graph=False, allow_unused=False):
     """d func / d xs.  Single input -> Jacobian tensor [*out, *in];
     tuple input -> tuple of Jacobians (paddle's contract)."""
+    _no_create_graph(create_graph)
     single = not isinstance(xs, (list, tuple))
     args = (xs,) if single else tuple(xs)
     arrays = tuple(_unwrap(a) for a in args)
@@ -52,6 +62,7 @@ def jacobian(func, xs, create_graph=False, allow_unused=False):
 
 def hessian(func, xs, create_graph=False, allow_unused=False):
     """d^2 func / d xs^2 for a SCALAR-output func (paddle contract)."""
+    _no_create_graph(create_graph)
     single = not isinstance(xs, (list, tuple))
     args = (xs,) if single else tuple(xs)
     arrays = tuple(_unwrap(a) for a in args)

@@ -22,8 +22,10 @@ from ..tensor import Tensor, to_tensor
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-    "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
-    "RandomSampler", "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
+    "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "get_worker_info",
 ]
 
 
@@ -96,6 +98,32 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+class ConcatDataset(Dataset):
+    """Map-style concatenation (paddle/torch ConcatDataset)."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        enforce(len(self.datasets) > 0,
+                "ConcatDataset needs at least one dataset")
+        self.cumulative_sizes = np.cumsum(
+            [len(d) for d in self.datasets]).tolist()
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise ValueError(
+                f"index {idx - len(self) if idx < 0 else idx} out of "
+                f"range for ConcatDataset of length {len(self)}")
+        ds = int(np.searchsorted(self.cumulative_sizes, idx,
+                                 side="right"))
+        prev = self.cumulative_sizes[ds - 1] if ds else 0
+        return self.datasets[ds][idx - prev]
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+
 def random_split(dataset: Dataset, lengths: Sequence[int], generator=None):
     enforce(sum(lengths) == len(dataset), "lengths must sum to dataset size")
     perm = np.random.permutation(len(dataset))
@@ -141,6 +169,35 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, np.float64)
+        self._num_samples = int(num_samples)
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        return iter(np.random.choice(
+            len(self.weights), self._num_samples,
+            replace=self.replacement, p=p).tolist())
+
+    def __len__(self):
+        return self._num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices):
+        super().__init__(None)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.random.permutation(self.indices).tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class BatchSampler(Sampler):
@@ -241,10 +298,29 @@ def default_collate_fn(batch: List[Any]):
     return batch
 
 
-def _mp_worker_loop(dataset, task_q, res_q, init_fn, wid):
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_WORKER_INFO: "WorkerInfo | None" = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: its (id, num_workers,
+    dataset); None in the main process (paddle/torch contract)."""
+    return _WORKER_INFO
+
+
+def _mp_worker_loop(dataset, task_q, res_q, init_fn, wid,
+                    num_workers=0):
     """Subprocess worker: evaluates dataset[i] (numpy-level — workers
     must not touch jax; collation and device placement stay in the
     parent) and ships raw items back."""
+    global _WORKER_INFO
+    _WORKER_INFO = WorkerInfo(wid, num_workers, dataset)
     if init_fn is not None:
         init_fn(wid)
     while True:
@@ -353,7 +429,8 @@ class DataLoader:
         n_workers = min(self.num_workers, max(1, len(batches)))
         procs = [ctx.Process(target=_mp_worker_loop,
                              args=(self.dataset, task_q, res_q,
-                                   self.worker_init_fn, w), daemon=True)
+                                   self.worker_init_fn, w, n_workers),
+                             daemon=True)
                  for w in range(n_workers)]
         for p in procs:
             p.start()

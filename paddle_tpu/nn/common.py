@@ -283,12 +283,13 @@ class PReLU(Layer):
     def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
                  data_format="NCHW", name=None):
         super().__init__()
+        self.data_format = data_format
         self.weight = self.create_parameter(
             [num_parameters], attr=weight_attr,
             default_initializer=Constant(init))
 
     def forward(self, x):
-        return F.prelu(x, self.weight)
+        return F.prelu(x, self.weight, data_format=self.data_format)
 
 
 class RReLU(Layer):

@@ -36,8 +36,16 @@ def leaky_relu(x, negative_slope=0.01):
     return jax.nn.leaky_relu(x, negative_slope)
 
 
-def prelu(x, weight):
-    return jnp.where(x >= 0, x, weight * x)
+def prelu(x, weight, data_format="NCHW"):
+    """Per-channel weight broadcasts along the CHANNEL axis (paddle
+    contract); scalar weight broadcasts everywhere."""
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        caxis = x.ndim - 1 if data_format.endswith("C") else 1
+        shape = [1] * x.ndim
+        shape[caxis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
 
 
 def elu(x, alpha=1.0):

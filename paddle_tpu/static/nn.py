@@ -31,7 +31,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     from .. import ops as P
     from ..common.errors import enforce
     enforce(1 <= num_flatten_dims < len(x.shape),
-            f"num_flatten_dims must be in [1, {len(x.shape) - 1})")
+            f"num_flatten_dims must be in [1, {len(x.shape) - 1}]")
     in_features = int(np.prod(x.shape[num_flatten_dims:]))
     if num_flatten_dims != len(x.shape) - 1:
         x = P.reshape(x, list(x.shape[:num_flatten_dims]) + [-1])
@@ -119,11 +119,9 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None,
                bias_attr=None, act=None, data_layout="NCHW", name=None):
-    from ..common.errors import enforce
-    enforce(data_layout == "NCHW",
-            "static.nn.group_norm supports NCHW (channel-first) input")
-    layer = _nn.GroupNorm(groups, input.shape[1], epsilon=epsilon,
-                          weight_attr=param_attr, bias_attr=bias_attr)
+    layer = _nn.GroupNorm(groups, _channels(input, data_layout),
+                          epsilon=epsilon, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_layout)
     out = layer(input)
     if act:
         out = getattr(_nn.functional, act)(out)

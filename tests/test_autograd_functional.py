@@ -36,6 +36,34 @@ def test_jacobian_multi_input_and_through_layer():
                                atol=1e-5)
 
 
+def test_create_graph_rejected_not_silently_detached():
+    import pytest
+    x = t(np.ones(2, np.float32))
+    with pytest.raises(Exception):
+        paddle.autograd.jacobian(lambda a: a * a, x, create_graph=True)
+    with pytest.raises(Exception):
+        paddle.autograd.hessian(lambda a: (a * a).sum(), x,
+                                create_graph=True)
+
+
+def test_prelu_channel_mode_vs_torch():
+    import pytest
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 6, 4, 5)).astype(np.float32)
+    w = rng.uniform(0.1, 0.5, 6).astype(np.float32)
+    ours = paddle.nn.functional.prelu(t(x), t(w)).numpy()
+    ref = torch.nn.functional.prelu(torch.tensor(x),
+                                    torch.tensor(w)).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-6)
+    # channel-last: weight follows the LAST axis
+    xl = np.moveaxis(x, 1, -1)
+    ours = paddle.nn.functional.prelu(t(xl), t(w),
+                                      data_format="NHWC").numpy()
+    np.testing.assert_allclose(np.asarray(ours),
+                               np.moveaxis(ref, 1, -1), atol=1e-6)
+
+
 def test_vjp_jvp():
     x = t(np.array([1.0, 2.0, 3.0], np.float32))
     out, g = paddle.autograd.vjp(lambda a: a * a, x)

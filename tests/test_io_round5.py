@@ -1,0 +1,65 @@
+"""Round-5 io additions: ConcatDataset, Weighted/SubsetRandomSampler,
+get_worker_info inside worker processes."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import io
+
+
+def test_concat_dataset_indexing():
+    d1 = io.TensorDataset([paddle.to_tensor(
+        np.arange(4, dtype=np.float32))])
+    d2 = io.TensorDataset([paddle.to_tensor(
+        np.arange(4, 7, dtype=np.float32))])
+    cd = io.ConcatDataset([d1, d2])
+    assert len(cd) == 7
+    got = [float(np.asarray(cd[i][0].numpy())) for i in range(7)]
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    assert float(np.asarray(cd[-1][0].numpy())) == 6.0
+
+
+def test_concat_dataset_rejects_out_of_range():
+    import pytest
+    cd = io.ConcatDataset([io.TensorDataset(
+        [paddle.to_tensor(np.arange(5, dtype=np.float32))])] * 2)
+    with pytest.raises(ValueError):
+        cd[-15]
+    with pytest.raises(ValueError):
+        cd[10]
+
+
+def test_weighted_and_subset_samplers():
+    np.random.seed(0)
+    ws = list(iter(io.WeightedRandomSampler([0.0, 0.0, 1.0], 5)))
+    assert ws == [2] * 5
+    sr = io.SubsetRandomSampler([1, 3, 5])
+    assert sorted(iter(sr)) == [1, 3, 5] and len(sr) == 3
+    # weighted without replacement draws distinct indices
+    np.random.seed(0)
+    ws2 = list(iter(io.WeightedRandomSampler([1, 1, 1, 1], 4,
+                                             replacement=False)))
+    assert sorted(ws2) == [0, 1, 2, 3]
+
+
+class _ProbeDataset(io.Dataset):
+    """Returns (worker_id, num_workers) seen inside the worker."""
+
+    def __getitem__(self, idx):
+        info = io.get_worker_info()
+        if info is None:
+            return np.array([-1, -1])
+        return np.array([info.id, info.num_workers])
+
+    def __len__(self):
+        return 8
+
+
+def test_get_worker_info_in_workers():
+    assert io.get_worker_info() is None     # main process
+    dl = io.DataLoader(_ProbeDataset(), batch_size=2, num_workers=2,
+                       shuffle=False)
+    rows = np.concatenate([np.asarray(b[0] if isinstance(b, (list,
+                           tuple)) else b) for b in dl])
+    ids = set(rows[:, 0].tolist())
+    assert ids.issubset({0, 1}) and -1 not in ids
+    assert set(rows[:, 1].tolist()) == {2}
