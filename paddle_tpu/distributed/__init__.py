@@ -21,25 +21,37 @@ from .auto_parallel import (
     set_mesh,
     shard_layer,
     shard_tensor,
+    unshard_dtensor,
 )
 from .collective import (
     ReduceOp,
     all_gather,
+    all_gather_object,
     all_reduce,
     all_to_all,
+    all_to_all_single,
     alltoall,
+    alltoall_single,
     barrier,
     broadcast,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
     get_group,
+    irecv,
+    isend,
     new_group,
     recv,
     reduce,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     send,
     stream,
+    wait,
 )
-from .env import get_rank, get_world_size
+from .checkpoint import load_state_dict, save_state_dict
+from .env import ParallelEnv, get_rank, get_world_size, spawn
 from .fleet import fleet
 from .strategy import DistributedStrategy
 from .topology import CommGroup, HybridCommunicateGroup, build_mesh

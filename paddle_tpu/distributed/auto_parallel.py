@@ -169,6 +169,24 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs) -> Tensor:
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+def unshard_dtensor(x: Tensor) -> Tensor:
+    """Gather a distributed tensor back to a fully-replicated local
+    tensor (paddle.distributed.unshard_dtensor)."""
+    import jax
+
+    val = x.value if isinstance(x, Tensor) else x
+    if hasattr(val, "is_fully_addressable") and \
+            not val.is_fully_addressable:
+        import numpy as np
+        val = jax.numpy.asarray(
+            np.asarray(jax.experimental.multihost_utils
+                       .process_allgather(val)))
+    out = Tensor(jax.device_get(val))
+    if hasattr(x, "trainable"):
+        out._stop_gradient = x._stop_gradient
+    return out
+
+
 def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
                 output_fn=None):
     """paddle.distributed.shard_layer: apply shard_fn(name, layer, mesh)

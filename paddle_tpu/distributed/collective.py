@@ -444,6 +444,134 @@ def recv(tensor, src: int, group: Optional[CommGroup] = None,
         "point-to-point send/recv: use ppermute inside shard_map")
 
 
+def isend(tensor, dst: int, group: Optional[CommGroup] = None):
+    raise NotImplementedError(
+        "point-to-point isend/irecv: use ppermute inside shard_map "
+        "(the PP schedule does) — per-process p2p does not exist "
+        "under SPMD")
+
+
+def irecv(tensor, src: int, group: Optional[CommGroup] = None):
+    raise NotImplementedError(
+        "point-to-point isend/irecv: use ppermute inside shard_map")
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """XLA collectives are synchronous at the python level — block on
+    the value (reference parity for the sync path)."""
+    val = _unwrap(tensor)
+    if not _is_traced(val):
+        jax.block_until_ready(val)
+    return tensor
+
+
+def all_to_all_single(out_tensor, in_tensor,
+                      out_split_sizes=None, in_split_sizes=None,
+                      group: Optional[CommGroup] = None,
+                      sync_op: bool = True):
+    """Single-array alltoall (equal splits; ragged splits are the
+    ragged_all_to_all path in expert_parallel)."""
+    enforce(out_split_sizes is None and in_split_sizes is None,
+            "all_to_all_single supports equal splits; ragged exchange "
+            "is distributed.expert_parallel's ragged_all_to_all")
+    res = all_to_all(in_tensor, group=group, sync_op=sync_op)
+    if hasattr(out_tensor, "_replace_from"):
+        out_tensor._replace_from(res if isinstance(res, Tensor)
+                                 else Tensor(res))
+        return out_tensor
+    return res
+
+
+alltoall_single = all_to_all_single
+
+
+def gather(tensor, gather_list=None, dst: int = 0,
+           group: Optional[CommGroup] = None, sync_op: bool = True):
+    """paddle.distributed.gather: dst receives every rank's tensor.
+    Under single-program SPMD every controller holds the gathered
+    list (a superset of the reference's contract)."""
+    if gather_list is None:
+        gather_list = []
+    all_gather(gather_list, tensor, group)
+    return gather_list
+
+
+def destroy_process_group(group=None):
+    """Tear down eager-collective state (the jax runtime itself stays
+    up — the reference's NCCL communicator destruction has no XLA
+    analog; caches are dropped so a new init starts clean)."""
+    _CROSS_JITS.clear()
+    _IDENTITY_WARNED.clear()
+
+
+# -- object collectives (pickle over the array collectives) -----------------
+
+def _obj_to_buf(obj):
+    import pickle
+    import numpy as np
+    return np.frombuffer(pickle.dumps(obj), np.uint8)
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather python objects: two array collectives (lengths, then
+    max-padded pickle payloads)."""
+    import pickle
+    import numpy as np
+    data = _obj_to_buf(obj)
+    lens = []
+    all_gather(lens, Tensor(jnp.asarray(
+        np.asarray([len(data)], np.int32))), group)
+    nlens = [int(np.asarray(_unwrap(v))[0]) for v in lens]
+    pad = np.zeros(max(nlens), np.uint8)
+    pad[:len(data)] = data
+    bufs = []
+    all_gather(bufs, Tensor(jnp.asarray(pad)), group)
+    object_list.extend(
+        pickle.loads(np.asarray(_unwrap(b))[:n].tobytes())
+        for b, n in zip(bufs, nlens))
+    return object_list
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    """Broadcast a list of python objects from src (in place)."""
+    import pickle
+    import numpy as np
+    data = _obj_to_buf(object_list)
+    ln = broadcast(Tensor(jnp.asarray(
+        np.asarray([len(data)], np.int32))), src, group)
+    n = int(np.asarray(_unwrap(ln))[0])
+    pad = np.zeros(max(n, len(data)), np.uint8)
+    pad[:len(data)] = data
+    out = broadcast(Tensor(jnp.asarray(pad[:n])), src, group)
+    got = pickle.loads(np.asarray(_unwrap(out))[:n].tobytes())
+    object_list[:] = got
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives in_object_list[its GROUP rank] from src."""
+    gathered = []
+    all_gather_object(gathered, in_object_list, group)
+    if isinstance(group, ProcessSubsetGroup):
+        src_in_group = group.rank_in_group(src)
+        enforce(src_in_group >= 0,
+                f"scatter src {src} not in group {group.ranks}")
+        from . import env as _env
+        my_in_group = group.rank_in_group(_env.get_rank())
+    else:
+        src_in_group = src
+        from . import env as _env
+        my_in_group = _env.get_rank() if jax.process_count() > 1 else 0
+    src_list = gathered[src_in_group]
+    enforce(src_list is not None and my_in_group < len(src_list),
+            f"scatter_object_list needs one object per group rank: "
+            f"got {0 if src_list is None else len(src_list)} for rank "
+            f"{my_in_group}")
+    out_object_list[:] = [src_list[my_in_group]]
+    return out_object_list
+
+
 class stream:
     """paddle.distributed.stream.* namespace parity (sync collectives)."""
     all_reduce = staticmethod(all_reduce)

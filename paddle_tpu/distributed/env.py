@@ -59,3 +59,86 @@ def get_world_size() -> int:
         return jax.process_count()
     except RuntimeError:
         return 1
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv parity: rank/world-size view of
+    the launch env."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        # per-NODE rank when the launch controller exported it
+        if "PADDLE_LOCAL_RANK" in os.environ:
+            return int(os.environ["PADDLE_LOCAL_RANK"])
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus",
+                                  os.environ.get("FLAGS_selected_gpus",
+                                                 "0")).split(",")[0])
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = get_rank()
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+
+
+def _spawn_worker(fn, rank, nprocs, master, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = master
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn: run ``func`` in ``nprocs`` processes
+    with the launch env seeded (each worker's init_parallel_env joins
+    one jax.distributed runtime — the TCPStore-rendezvous analog)."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 1:
+        func(*args)
+        return None
+    master = options.get("master")
+    holder = None
+    if master is None:
+        # hold the port until just before the workers launch to shrink
+        # the reuse race; pass options['master'] to eliminate it
+        holder = socket.socket()
+        holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        holder.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{holder.getsockname()[1]}"
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_spawn_worker,
+                         args=(func, r, nprocs, master, args),
+                         daemon=daemon)
+             for r in range(nprocs)]
+    if holder is not None:
+        holder.close()
+    for p in procs:
+        p.start()
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn worker(s) failed: {bad}")
+    return procs

@@ -1,0 +1,64 @@
+"""paddle.hub — load models from hubconf.py entrypoints.
+
+Reference parity: python/paddle/hapi/hub.py (hub.list / hub.help /
+hub.load over a ``hubconf.py`` protocol).  The local-dir source works
+fully; remote github/gitee sources need network egress and raise a
+clear error instead of half-working (this environment is air-gapped;
+the protocol — entrypoints are callables in hubconf.py, `dependencies`
+is an optional requirements list — is identical)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from .common.errors import enforce
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    enforce(os.path.isfile(path), f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    enforce(source in ("local", "github", "gitee"),
+            f"unknown hub source {source!r}")
+    if source != "local":
+        raise NotImplementedError(
+            "paddle.hub remote sources need network egress; clone the "
+            "repo and use source='local' with its path")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")
+            and n != "dependencies"]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    enforce(hasattr(mod, model), f"no entrypoint {model!r}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call the named hubconf entrypoint with **kwargs."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    enforce(hasattr(mod, model), f"no entrypoint {model!r}")
+    return getattr(mod, model)(**kwargs)

@@ -110,11 +110,17 @@ class ConcatDataset(Dataset):
 
     def __getitem__(self, idx):
         if idx < 0:
+            if idx < -len(self):
+                raise ValueError(
+                    "absolute value of index should not exceed dataset "
+                    f"length ({len(self)})")
             idx += len(self)
-        if not 0 <= idx < len(self):
-            raise ValueError(
-                f"index {idx - len(self) if idx < 0 else idx} out of "
-                f"range for ConcatDataset of length {len(self)}")
+        if idx >= len(self):
+            # IndexError, not ValueError: plain for-loops over
+            # map-style datasets terminate via the sequence protocol
+            raise IndexError(
+                f"index {idx} out of range for ConcatDataset of "
+                f"length {len(self)}")
         ds = int(np.searchsorted(self.cumulative_sizes, idx,
                                  side="right"))
         prev = self.cumulative_sizes[ds - 1] if ds else 0

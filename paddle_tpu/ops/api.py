@@ -223,6 +223,35 @@ def zero_(self):
 TENSOR_METHODS["fill_"] = fill_
 TENSOR_METHODS["zero_"] = zero_
 
+# paddle Tensor-method long tail: aliases + trivial introspection
+for _name in ("conj", "dist", "cross"):
+    if _name in _NS:
+        TENSOR_METHODS[_name] = _NS[_name]
+TENSOR_METHODS["sub_"] = TENSOR_METHODS["subtract_"]
+TENSOR_METHODS["dim"] = lambda self: len(self.shape)
+TENSOR_METHODS["ndimension"] = lambda self: len(self.shape)
+TENSOR_METHODS["element_size"] = \
+    lambda self: self.value.dtype.itemsize
+TENSOR_METHODS["t"] = lambda self: _NS["transpose"](self, [1, 0]) \
+    if len(self.shape) == 2 else _NS["transpose"](
+        self, list(range(len(self.shape)))[::-1])
+TENSOR_METHODS["contiguous"] = lambda self: self
+TENSOR_METHODS["is_contiguous"] = lambda self: True
+TENSOR_METHODS["get_tensor"] = lambda self: self
+
+
+def _mk_inplace_shapeop(name):
+    f = _NS[name]
+
+    def inplace(self, *args, **kwargs):
+        self._replace_from(f(self, *args, **kwargs))
+        return self
+    return inplace
+
+
+for _name in ("flatten", "reshape"):
+    TENSOR_METHODS[_name + "_"] = _mk_inplace_shapeop(_name)
+
 
 # -- operator overloads ------------------------------------------------------
 

@@ -114,6 +114,17 @@ class Tensor:
         from . import ops
         return ops.transpose(self, list(range(self.ndim))[::-1])
 
+    @property
+    def mT(self) -> "Tensor":
+        from . import ops
+        if self.ndim < 2:
+            raise ValueError(
+                f"mT requires a tensor with at least 2 dimensions, "
+                f"got {self.ndim}")
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.transpose(self, perm)
+
     # -- autograd -----------------------------------------------------------
     def backward(self, grad_tensor=None, retain_graph: bool = False):
         tape.backward(self, grad_tensor, retain_graph)

@@ -1,0 +1,33 @@
+"""Driver for test_distributed_round5: paddle.distributed.spawn runs
+2 processes that join one runtime and all_reduce across it."""
+import os
+import sys
+
+
+def worker(tag_dir):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    assert dist.env.init_parallel_env()
+    assert jax.process_count() == 2
+    dist.fleet.init(is_collective=True)
+    rank = dist.get_rank()
+    val = paddle.to_tensor(np.asarray([float(rank + 1)], np.float32))
+    out = dist.all_reduce(val)
+    got = float(np.asarray(out.numpy())[0])
+    assert got == 3.0, got            # 1 + 2 across the two processes
+    with open(os.path.join(tag_dir, f"ok{rank}"), "w") as f:
+        f.write(str(got))
+
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu.distributed as dist
+
+    dist.spawn(worker, args=(sys.argv[1],), nprocs=2)
+    print("SPAWN_OK")

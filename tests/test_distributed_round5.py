@@ -1,0 +1,84 @@
+"""Round-5 paddle.distributed surface: object collectives, gather,
+wait, alltoall_single, ParallelEnv, unshard_dtensor, spawn (real
+2-process run)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+t = paddle.to_tensor
+
+
+@pytest.fixture(autouse=True)
+def _fleet():
+    # function-scoped: the global conftest tears fleet state down after
+    # every test
+    dist.fleet.init(is_collective=True)
+    yield
+
+
+def test_object_collectives_single_controller():
+    objs = []
+    dist.all_gather_object(objs, {"a": 1, "b": [2, 3]})
+    assert len(objs) == dist.get_group().nranks
+    assert all(o == {"a": 1, "b": [2, 3]} for o in objs)
+
+    lst = [{"x": 7}, "s"]
+    dist.broadcast_object_list(lst, src=0)
+    assert lst == [{"x": 7}, "s"]
+
+    out = [None]
+    dist.scatter_object_list(out, [["r0"], ["r1"]], src=0)
+    assert out == [["r0"]]
+
+
+def test_gather_wait_alltoall_single():
+    g = dist.gather(t(np.ones(3, np.float32)))
+    assert len(g) == dist.get_group().nranks
+    w = dist.wait(t(np.ones(2, np.float32)))
+    assert tuple(w.shape) == (2,)
+    r = dist.all_to_all_single(t(np.zeros(8, np.float32)),
+                               t(np.arange(8, dtype=np.float32)))
+    assert tuple(r.shape) == (8,)
+    with pytest.raises(Exception):
+        dist.all_to_all_single(t(np.zeros(8, np.float32)),
+                               t(np.arange(8, dtype=np.float32)),
+                               in_split_sizes=[3, 5])
+
+
+def test_parallel_env_and_unshard():
+    pe = dist.ParallelEnv()
+    assert pe.rank == dist.get_rank()
+    assert pe.world_size == dist.get_world_size()
+    u = dist.unshard_dtensor(t(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(np.asarray(u.numpy()), np.ones((2, 2)))
+
+
+def test_isend_irecv_raise_with_guidance():
+    x = t(np.ones(2, np.float32))
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        dist.isend(x, 1)
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        dist.irecv(x, 0)
+
+
+def test_spawn_two_processes_all_reduce(tmp_path):
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    env.pop("PADDLE_MASTER", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "spawn_script.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SPAWN_OK" in out.stdout
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()

@@ -24,8 +24,10 @@ def test_concat_dataset_rejects_out_of_range():
         [paddle.to_tensor(np.arange(5, dtype=np.float32))])] * 2)
     with pytest.raises(ValueError):
         cd[-15]
-    with pytest.raises(ValueError):
+    # positive overflow is IndexError so plain for-loops terminate
+    with pytest.raises(IndexError):
         cd[10]
+    assert len([x for x in cd]) == 10
 
 
 def test_weighted_and_subset_samplers():
@@ -63,3 +65,43 @@ def test_get_worker_info_in_workers():
     ids = set(rows[:, 0].tolist())
     assert ids.issubset({0, 1}) and -1 not in ids
     assert set(rows[:, 1].tolist()) == {2}
+
+
+def test_hub_local_protocol(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = []\n'
+        'def tiny_linear(out_features=3):\n'
+        '    """A tiny linear model entrypoint."""\n'
+        '    import paddle_tpu as paddle\n'
+        '    return paddle.nn.Linear(4, out_features)\n')
+    d = str(tmp_path)
+    assert paddle.hub.list(d) == ["tiny_linear"]
+    assert "tiny linear" in paddle.hub.help(d, "tiny_linear")
+    m = paddle.hub.load(d, "tiny_linear", out_features=5)
+    assert tuple(m(paddle.to_tensor(
+        np.ones((2, 4), np.float32))).shape) == (2, 5)
+    import pytest
+    with pytest.raises(NotImplementedError):
+        paddle.hub.list("owner/repo", source="github")
+
+
+def test_tensor_method_long_tail():
+    t = paddle.to_tensor
+    x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.dim() == x.ndimension() == 2
+    assert x.element_size() == 4
+    assert tuple(x.t().shape) == (3, 2)
+    assert tuple(t(np.ones((2, 3, 4), np.float32)).mT.shape) == (2, 4, 3)
+    assert x.contiguous() is x and x.is_contiguous()
+    y = x.clone()
+    y.sub_(t(np.ones((2, 3), np.float32)))
+    np.testing.assert_allclose(np.asarray(y.numpy())[0], [-1, 0, 1])
+    z = x.clone()
+    z.reshape_([3, 2])
+    assert tuple(z.shape) == (3, 2)
+    z.flatten_()
+    assert tuple(z.shape) == (6,)
+    assert float(np.asarray(x.dist(x).numpy())) == 0.0
+    import pytest
+    with pytest.raises(ValueError, match="at least 2"):
+        t(np.ones(3, np.float32)).mT
