@@ -592,7 +592,12 @@ def bench_paged_kernel():
                       "dense_us_per_step": round(t_dense, 1),
                       "paged_over_dense": round(t_paged / t_dense, 2),
                       "note": "fused append+attend kernel, in-graph "
-                              "scan x256; r3 path was ~18x dense"}}
+                              "scan x256; r3 path was ~18x dense; the "
+                              "dense comparator sped up ~25% when sdpa "
+                              "moved to the shard_map flash dispatch "
+                              "(r5), so expect ~1.25-1.35x — the "
+                              "kernel itself is unchanged "
+                              "(bisect-verified, BASELINE.md)"}}
 
 
 def bench_engine_window():

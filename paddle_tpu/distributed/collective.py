@@ -499,7 +499,18 @@ def gather(tensor, gather_list=None, dst: int = 0,
 def destroy_process_group(group=None):
     """Tear down eager-collective state (the jax runtime itself stays
     up — the reference's NCCL communicator destruction has no XLA
-    analog; caches are dropped so a new init starts clean)."""
+    analog).  With a specific ``group``, only that group is
+    deregistered; with None, ALL group state and caches drop so the
+    next collective requires a fresh init."""
+    global _DEFAULT_GROUP, _WORLD_PG
+    if group is not None:
+        _GROUPS.pop(id(group), None)
+        if group is _DEFAULT_GROUP:
+            _DEFAULT_GROUP = None
+        return
+    _GROUPS.clear()
+    _DEFAULT_GROUP = None
+    _WORLD_PG = None
     _CROSS_JITS.clear()
     _IDENTITY_WARNED.clear()
 
@@ -550,24 +561,23 @@ def broadcast_object_list(object_list, src: int = 0, group=None):
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
-    """Each rank receives in_object_list[its GROUP rank] from src."""
-    gathered = []
-    all_gather_object(gathered, in_object_list, group)
+    """Each rank receives in_object_list[its GROUP rank] from src —
+    one broadcast of src's list (not an all-gather of every rank's)."""
+    from . import env as _env
     if isinstance(group, ProcessSubsetGroup):
-        src_in_group = group.rank_in_group(src)
-        enforce(src_in_group >= 0,
+        enforce(group.rank_in_group(src) >= 0,
                 f"scatter src {src} not in group {group.ranks}")
-        from . import env as _env
         my_in_group = group.rank_in_group(_env.get_rank())
+        enforce(my_in_group >= 0,
+                f"rank {_env.get_rank()} is not a member of group "
+                f"{group.ranks}")
     else:
-        src_in_group = src
-        from . import env as _env
         my_in_group = _env.get_rank() if jax.process_count() > 1 else 0
-    src_list = gathered[src_in_group]
-    enforce(src_list is not None and my_in_group < len(src_list),
+    src_list = list(in_object_list) if in_object_list is not None else []
+    broadcast_object_list(src_list, src=src, group=group)
+    enforce(my_in_group < len(src_list),
             f"scatter_object_list needs one object per group rank: "
-            f"got {0 if src_list is None else len(src_list)} for rank "
-            f"{my_in_group}")
+            f"got {len(src_list)} for rank {my_in_group}")
     out_object_list[:] = [src_list[my_in_group]]
     return out_object_list
 

@@ -135,10 +135,24 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
         holder.close()
     for p in procs:
         p.start()
+    ctx_obj = MultiprocessContext(procs)
     if join:
-        for p in procs:
-            p.join()
-        bad = [p.exitcode for p in procs if p.exitcode]
+        ctx_obj.join()
+        return None
+    return ctx_obj
+
+
+class MultiprocessContext:
+    """paddle.distributed.spawn(join=False) return value: .join() with
+    exit-code propagation, .processes list."""
+
+    def __init__(self, processes):
+        self.processes = list(processes)
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        bad = [p.exitcode for p in self.processes if p.exitcode]
         if bad:
             raise RuntimeError(f"spawn worker(s) failed: {bad}")
-    return procs
+        return True

@@ -197,17 +197,18 @@ for _n in ("rank", "numel", "is_empty", "clone", "is_complex",
     TENSOR_METHODS[_n] = _NS[_n]
 
 
+def _mk(f):
+    """In-place method factory: run op, replace self's storage."""
+    def inplace(self, *args, **kwargs):
+        self._replace_from(f(self, *args, **kwargs))
+        return self
+    return inplace
+
+
 for _name in ("add", "subtract", "multiply", "divide", "clip", "scale",
               "exp", "sqrt", "reciprocal", "floor", "ceil", "round",
               "squeeze", "unsqueeze", "cast", "tanh"):
-    _f = TENSOR_METHODS[_name]
-
-    def _mk(f):
-        def inplace(self, *args, **kwargs):
-            self._replace_from(f(self, *args, **kwargs))
-            return self
-        return inplace
-    TENSOR_METHODS[_name + "_"] = _mk(_f)
+    TENSOR_METHODS[_name + "_"] = _mk(TENSOR_METHODS[_name])
 
 
 def fill_(self, value):
@@ -232,25 +233,27 @@ TENSOR_METHODS["dim"] = lambda self: len(self.shape)
 TENSOR_METHODS["ndimension"] = lambda self: len(self.shape)
 TENSOR_METHODS["element_size"] = \
     lambda self: self.value.dtype.itemsize
-TENSOR_METHODS["t"] = lambda self: _NS["transpose"](self, [1, 0]) \
-    if len(self.shape) == 2 else _NS["transpose"](
-        self, list(range(len(self.shape)))[::-1])
+
+
+def _t_method(self):
+    # reference contract: t() is for 0/1/2-D only (a silent all-dim
+    # reverse on higher ranks would mask caller bugs)
+    if len(self.shape) > 2:
+        raise ValueError(
+            f"t() expects a tensor with <= 2 dimensions, got "
+            f"{len(self.shape)}; use .T / transpose(perm)")
+    if len(self.shape) < 2:
+        return self
+    return _NS["transpose"](self, [1, 0])
+
+
+TENSOR_METHODS["t"] = _t_method
 TENSOR_METHODS["contiguous"] = lambda self: self
 TENSOR_METHODS["is_contiguous"] = lambda self: True
 TENSOR_METHODS["get_tensor"] = lambda self: self
 
-
-def _mk_inplace_shapeop(name):
-    f = _NS[name]
-
-    def inplace(self, *args, **kwargs):
-        self._replace_from(f(self, *args, **kwargs))
-        return self
-    return inplace
-
-
 for _name in ("flatten", "reshape"):
-    TENSOR_METHODS[_name + "_"] = _mk_inplace_shapeop(_name)
+    TENSOR_METHODS[_name + "_"] = _mk(_NS[_name])
 
 
 # -- operator overloads ------------------------------------------------------
