@@ -42,7 +42,7 @@ _NEG_INF = float(-1e30)
 _LANES = 128
 
 
-_NBUF = 4          # DMA pipeline depth: outstanding page copies per stream
+_NBUF = 8          # DMA pipeline depth: outstanding page copies per stream
 
 
 def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,

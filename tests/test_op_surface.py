@@ -515,6 +515,15 @@ def _special_cases(e):
         "max_unpool3d": lambda: F.max_unpool3d(
             *F.max_pool3d(t(rng.standard_normal((1, 2, 4, 4, 4))), 2,
                           return_mask=True), 2),
+        "bilinear": lambda: F.bilinear(
+            M, t(rng.standard_normal((4, 3))),
+            t(rng.standard_normal((5, 4, 3)))),
+        "conv1d_transpose": lambda: F.conv1d_transpose(
+            t(rng.standard_normal((2, 4, 10))),
+            t(rng.standard_normal((4, 3, 5))), stride=2),
+        "conv3d_transpose": lambda: F.conv3d_transpose(
+            t(rng.standard_normal((1, 4, 5, 5, 5))),
+            t(rng.standard_normal((4, 2, 3, 3, 3))), stride=2),
         "addcdiv": lambda: paddle.addcdiv(M, M, SPD),
         "addcmul": lambda: paddle.addcmul(M, M, M),
         "set_printoptions": lambda: paddle.set_printoptions(precision=8),
