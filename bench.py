@@ -727,6 +727,102 @@ def bench_engine():
                       "decode_compiles": LLMEngine.decode_compiles()}}
 
 
+def bench_serving_quant():
+    """Quantized-serving row (ISSUE 1): decode tokens/sec through the
+    engine with an fp KV cache vs the INT8 paged KV cache (per-token
+    scales, in-kernel dequant on TPU), plus the EFFECTIVE PAGE
+    CAPACITY the int8 cache buys at an equal HBM budget vs fp16 —
+    the bandwidth/capacity win is the point of the subsystem, so the
+    row reports both.  Same JSON shape as the headline metric so
+    BENCH_*.json rounds can track the quantized path."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.inference.paged_cache import PagedKVCache
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 256, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        fp_dtype = jnp_bf16()
+        fp_kv = "bfloat16"
+    else:
+        # tiny model, but the SERVING head_dim (128): the capacity
+        # claim is per-token bytes D+4 vs 2D, a function of head_dim
+        cfg = LlamaConfig(vocab_size=256, hidden_size=256,
+                          intermediate_size=512, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=128,
+                          rope_theta=10000.0)
+        batch, new, page, maxlen, sync = 2, 16, 8, 64, 4
+        prompts = [8, 5]
+        fp_dtype = np.float32
+        fp_kv = None
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    def run(kv_dtype):
+        eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                        page_size=page, dtype=fp_dtype,
+                        steps_per_sync=sync, kv_dtype=kv_dtype)
+        for i, plen in enumerate(prompts):
+            eng.add_request(
+                f"w{i}", rng.integers(1, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=new)
+        eng.step()                   # warmup: compile the decode window
+        produced0 = sum(len(r.out) for r in eng.requests.values())
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.out) for r in eng.requests.values()) - produced0
+        return total / dt, eng
+
+    tps_fp, _ = run(fp_kv)
+    tps_q, eng_q = run("int8")
+
+    # effective page capacity at an EQUAL HBM budget, vs an fp16 cache
+    # (honest accounting: int8 pages carry their f32 scale rows)
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    geom = dict(n_pages=2, page_size=page,
+                n_kv_heads=cfg.num_key_value_heads, head_dim=head_dim,
+                max_seqs=1, max_len=page,
+                num_layers=cfg.num_hidden_layers)
+    bpt_fp16 = PagedKVCache(dtype=jnp.bfloat16, **geom) \
+        .kv_bytes_per_token()
+    bpt_int8 = eng_q.cache.kv_bytes_per_token()
+    cap_ratio = bpt_fp16 / bpt_int8
+    budget = hbm or 16e9
+    page_bytes_fp16 = bpt_fp16 * page
+    page_bytes_int8 = bpt_int8 * page
+    return {
+        "metric": "serving_decode_int8_vs_fp_kv_tokens_per_sec",
+        "value": round(tps_q, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps_q / tps_fp, 3),
+        "extra": {"device_kind": kind, "max_seqs": batch,
+                  "new_tokens": new, "page_size": page,
+                  "fp_kv_dtype": fp_kv or "float32",
+                  "fp_tokens_per_sec": round(tps_fp, 1),
+                  "int8_tokens_per_sec": round(tps_q, 1),
+                  "kv_bytes_per_token_fp16": bpt_fp16,
+                  "kv_bytes_per_token_int8": bpt_int8,
+                  "int8_capacity_ratio_vs_fp16": round(cap_ratio, 3),
+                  "pages_at_budget_fp16": int(budget // page_bytes_fp16),
+                  "pages_at_budget_int8": int(budget // page_bytes_int8),
+                  "hbm_budget_bytes": int(budget),
+                  "prefill_compiles": LLMEngine.prefill_compiles(),
+                  "decode_compiles": LLMEngine.decode_compiles()}}
+
+
 def jnp_bf16():
     import jax.numpy as jnp
     return jnp.bfloat16
@@ -838,6 +934,7 @@ def main():
                ("bench_moe_deepseek", bench_moe_deepseek),
                ("bench_paged_kernel", bench_paged_kernel),
                ("bench_engine", bench_engine),
+               ("bench_serving_quant", bench_serving_quant),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

@@ -45,6 +45,7 @@ from . import audio
 from . import fft
 from . import distribution
 from . import geometric
+from . import quantization
 from . import hub
 from . import linalg
 from . import regularizer

@@ -152,6 +152,14 @@ class MultiprocessContext:
     def join(self, timeout=None):
         for p in self.processes:
             p.join(timeout)
+        # a worker still alive after the timeout has exitcode None,
+        # which the truthiness check below would read as success
+        # (ADVICE r5 finding 4) — treat it as a timeout failure
+        hung = [p.pid for p in self.processes if p.is_alive()]
+        if hung:
+            raise RuntimeError(
+                f"spawn worker(s) still alive after join"
+                f"(timeout={timeout}): pids {hung}")
         bad = [p.exitcode for p in self.processes if p.exitcode]
         if bad:
             raise RuntimeError(f"spawn worker(s) failed: {bad}")

@@ -441,7 +441,9 @@ class Dirichlet(Distribution):
 
 
 class Geometric(Distribution):
-    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before success)."""
+    """P(X=k) = (1-p)^(k-1) p, k = 1, 2, ... — the reference's
+    trials-until-first-success convention (mean 1/p), NOT the torch
+    failures-before-success shift (ADVICE r5 finding 1)."""
 
     def __init__(self, probs, name=None):
         self.probs = _t(probs)
@@ -455,17 +457,17 @@ class Geometric(Distribution):
         def raw(p):
             import jax.numpy as jnp
             u = jax.random.uniform(key, shp, minval=1e-7, maxval=1.0)
-            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1.0
         return apply_op(raw, self.probs)
 
     def log_prob(self, value):
         def raw(v, p):
             import jax.numpy as jnp
-            return v * jnp.log1p(-p) + jnp.log(p)
+            return (v - 1.0) * jnp.log1p(-p) + jnp.log(p)
         return apply_op(raw, value, self.probs)
 
     def mean(self):
-        return (1.0 - self.probs) / self.probs
+        return 1.0 / self.probs
 
 
 class Poisson(Distribution):

@@ -29,13 +29,19 @@ def _seg_reduce(data, ids, pool_type, num):
                                   num_segments=num)
         shape = (num,) + (1,) * (data.ndim - 1)
         return s / jnp.maximum(cnt.reshape(shape), 1.0)
-    if pool_type == "max":
-        out = jax.ops.segment_max(data, ids, num_segments=num)
-        # paddle fills untouched rows with 0, not -inf
-        return jnp.where(jnp.isfinite(out), out, 0.0)
-    if pool_type == "min":
-        out = jax.ops.segment_min(data, ids, num_segments=num)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if pool_type in ("max", "min"):
+        fn = jax.ops.segment_max if pool_type == "max" \
+            else jax.ops.segment_min
+        out = fn(data, ids, num_segments=num)
+        # paddle fills untouched rows with 0, not the reduction
+        # identity.  Detect empties via a segment COUNT, not
+        # isfinite(out): integer data's identity is iinfo min/max
+        # (finite), and float data may legitimately hold +/-inf
+        # (ADVICE r5 finding 3).
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids), ids,
+                                  num_segments=num)
+        empty = (cnt == 0).reshape((num,) + (1,) * (data.ndim - 1))
+        return jnp.where(empty, jnp.zeros((), data.dtype), out)
     raise ValueError(f"unknown pool_type {pool_type}")
 
 

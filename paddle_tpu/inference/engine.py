@@ -22,6 +22,17 @@ pages so far under a position mask), so a mixed-length request stream
 costs a single prefill compile total — no length buckets at all.
 ``prefill_compiles()`` / ``decode_compiles()`` expose the jit cache
 sizes so ops can assert the no-recompile property.
+
+Quantized serving (the quantization subsystem's engine knobs):
+``kv_dtype="int8"`` stores the paged KV pools int8 with per-token
+scales — the Pallas decode kernel streams int8 pages and dequantizes
+in VMEM, roughly halving decode HBM traffic and doubling page capacity
+per chip vs fp16.  ``weight_dtype="int8"`` runs the decoder matmuls
+against int8 weights (per-output-channel absmax scales folded into the
+matmul outputs); models already converted by
+``paddle_tpu.quantization.quantize_model`` are picked up as-is.  Both
+knobs keep the no-recompile property: the quantized programs' shapes
+are still fixed by the engine geometry alone.
 """
 from __future__ import annotations
 
@@ -47,12 +58,31 @@ class GenRequest:
         self.done = False
 
 
+def _wout(w) -> int:
+    """Output width of a stacked weight — fp array [.., in, out] or
+    weight-only-int8 (values, scale) pair."""
+    return w[0].shape[-1] if isinstance(w, tuple) else w.shape[-1]
+
+
+def _mm(x, w):
+    """x @ w for fp or weight-only-int8 stacked weights.  The int8
+    scale is per-OUTPUT-channel, so it folds into the matmul result —
+    the MXU pass consumes the int8 weight upcast in registers, never a
+    materialized fp copy."""
+    import jax.numpy as jnp
+    if isinstance(w, tuple):
+        qw, sc = w
+        return jnp.matmul(x, qw.astype(x.dtype)) * sc.astype(x.dtype)
+    return jnp.matmul(x, w)
+
+
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head"),
-    donate_argnames=("k_pages", "v_pages"))
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
-                         k_pages, v_pages, ids, table, prev_len,
+                         k_pages, v_pages, k_scales, v_scales,
+                         ids, table, prev_len,
                          page_slot, last_in_chunk, *, eps: float,
                          kvh: int, head_dim: int,
                          transpose_head: bool = False):
@@ -74,16 +104,23 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
     only.  (The ``table`` must keep its static per-engine width —
     trimming it per prompt would re-introduce per-shape compiles.)
 
+    ``k_scales``/``v_scales`` ([L, KVH, n_pages, P] f32, or None for
+    fp pools) switch the cache write to int8: the chunk's K/V rows
+    quantize per token before the page dus, and the page gather
+    dequantizes for the chunk's (matmul-dominated) attention.
+
     ids [C] int32 (end-padded on the final chunk); table [maxp] this
     sequence's page table; prev_len tokens already prefilled;
     page_slot the pool index this chunk writes; last_in_chunk =
     clamp(plen-1 - chunk_base, 0, C-1) (the row whose logits matter
-    on the final chunk).  Returns (logits [V], k_pages', v_pages').
+    on the final chunk).  Returns (logits [V], k_pages', v_pages',
+    k_scales', v_scales').
     """
     import jax
     import jax.numpy as jnp
 
     from ..ops import _nn
+    from ..quantization.ops import quantize_rows_raw
     from ..runtime.device import is_compiled_with_tpu
 
     cos_t, sin_t = rope
@@ -127,37 +164,64 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
 
     def layer(carry, xs):
         hcur = carry
-        lp, kp, vp = xs                       # params + per-layer pools
+        lp, kp, vp, ksp, vsp = xs             # params + per-layer pools
         iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
         hn = _nn.rms_norm(hcur, iln, epsilon=eps)
-        nh = qw.shape[1] // head_dim
-        q = jnp.matmul(hn, qw).reshape(c, nh, head_dim)
-        k = jnp.matmul(hn, kw).reshape(c, kvh, head_dim)
-        v = jnp.matmul(hn, vw).reshape(c, kvh, head_dim)
+        nh = _wout(qw) // head_dim
+        q = _mm(hn, qw).reshape(c, nh, head_dim)
+        k = _mm(hn, kw).reshape(c, kvh, head_dim)
+        v = _mm(hn, vw).reshape(c, kvh, head_dim)
         qf, kf = q.astype(jnp.float32)[None], k.astype(jnp.float32)[None]
         q = (qf * cos + rotate_half(qf) * sin)[0].astype(q.dtype)
         k = (kf * cos + rotate_half(kf) * sin)[0].astype(k.dtype)
-        # whole-page write: [C, KVH, D] -> [KVH, 1, C(=P), D] block
-        kblk = jnp.swapaxes(k, 0, 1)[:, None].astype(kp.dtype)
-        vblk = jnp.swapaxes(v, 0, 1)[:, None].astype(vp.dtype)
-        kp = jax.lax.dynamic_update_slice(kp, kblk, (0, page_slot, 0, 0))
-        vp = jax.lax.dynamic_update_slice(vp, vblk, (0, page_slot, 0, 0))
-        # gather this sequence's pages (chunk included — just written)
-        k_full = kp[:, table].reshape(kvh, s_kv, head_dim)
-        v_full = vp[:, table].reshape(kvh, s_kv, head_dim)
+        if ksp is None:
+            # whole-page write: [C, KVH, D] -> [KVH, 1, C(=P), D] block
+            kblk = jnp.swapaxes(k, 0, 1)[:, None].astype(kp.dtype)
+            vblk = jnp.swapaxes(v, 0, 1)[:, None].astype(vp.dtype)
+            kp = jax.lax.dynamic_update_slice(kp, kblk,
+                                              (0, page_slot, 0, 0))
+            vp = jax.lax.dynamic_update_slice(vp, vblk,
+                                              (0, page_slot, 0, 0))
+            # gather this sequence's pages (chunk included — written)
+            k_full = kp[:, table].reshape(kvh, s_kv, head_dim)
+            v_full = vp[:, table].reshape(kvh, s_kv, head_dim)
+        else:
+            # int8 pools: quantize the chunk's rows (per-token absmax)
+            # before the page write; the gather dequantizes
+            kq8, ksc = quantize_rows_raw(k)   # [C, KVH, D], [C, KVH]
+            vq8, vsc = quantize_rows_raw(v)
+            kp = jax.lax.dynamic_update_slice(
+                kp, jnp.swapaxes(kq8, 0, 1)[:, None],
+                (0, page_slot, 0, 0))
+            vp = jax.lax.dynamic_update_slice(
+                vp, jnp.swapaxes(vq8, 0, 1)[:, None],
+                (0, page_slot, 0, 0))
+            ksp = jax.lax.dynamic_update_slice(
+                ksp, jnp.swapaxes(ksc, 0, 1)[:, None].astype(ksp.dtype),
+                (0, page_slot, 0))
+            vsp = jax.lax.dynamic_update_slice(
+                vsp, jnp.swapaxes(vsc, 0, 1)[:, None].astype(vsp.dtype),
+                (0, page_slot, 0))
+            k_full = (kp[:, table].astype(jnp.float32)
+                      * ksp[:, table][..., None]).reshape(kvh, s_kv,
+                                                          head_dim)
+            v_full = (vp[:, table].astype(jnp.float32)
+                      * vsp[:, table][..., None]).reshape(kvh, s_kv,
+                                                          head_dim)
         attn = attend(q, jnp.swapaxes(k_full, 0, 1),
                       jnp.swapaxes(v_full, 0, 1))
-        hcur = hcur + jnp.matmul(attn.reshape(c, nh * head_dim), ow)
+        hcur = hcur + _mm(attn.reshape(c, nh * head_dim), ow)
         hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
-        return hcur + jnp.matmul(ff, dw), (kp, vp)
+        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
+        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer, x, (tuple(stack), k_pages, v_pages))
+    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
     xl = jnp.take(x, last_in_chunk, axis=0)   # [H]
-    logits = jnp.matmul(xl, head_w.T if transpose_head else head_w)
-    return logits, k_pages, v_pages
+    logits = jnp.matmul(xl, head_w.T) if transpose_head \
+        else _mm(xl, head_w)
+    return logits, k_pages, v_pages, k_scales, v_scales
 
 
 @functools.partial(
@@ -165,9 +229,10 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
                      "n_steps"),
-    donate_argnames=("k_pages", "v_pages"))
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
-                       k_pages, v_pages, tokens, positions, tables, lens,
+                       k_pages, v_pages, k_scales, v_scales,
+                       tokens, positions, tables, lens,
                        key, *, eps: float, kvh: int, head_dim: int,
                        transpose_head: bool = False,
                        strategy: str = "greedy_search", top_k: int = 0,
@@ -180,9 +245,12 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     caller).
 
     stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
-    order); k/v_pages [L, KVH, n_pages, P, D]; tokens [B] int32;
-    positions [B] (= current lengths); tables [B, maxp]; lens [B].
-    Returns (tokens [n_steps, B], k_pages', v_pages').
+    order; weight-only-int8 entries are (values, scale) pairs);
+    k/v_pages [L, KVH, n_pages, P, D]; k/v_scales [L, KVH, n_pages, P]
+    f32 per-token dequant scales for int8 pools (None for fp); tokens
+    [B] int32; positions [B] (= current lengths); tables [B, maxp];
+    lens [B].  Returns (tokens [n_steps, B], k_pages', v_pages',
+    k_scales', v_scales').
     """
     import jax
     import jax.numpy as jnp
@@ -205,53 +273,70 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
         else paged_decode_append_attend_reference
 
     def one_token(carry):
-        tokens, positions, lens, k_pages, v_pages, key = carry
+        (tokens, positions, lens, k_pages, v_pages, k_scales, v_scales,
+         key) = carry
         x = jnp.take(embed_w, tokens, axis=0)  # [B, H]
         cos = jnp.take(cos_t, positions, axis=0)[:, None, :]  # [B,1,D]
         sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
 
         def layer(carry, xs):
             hcur = carry
-            lp, kp, vp = xs                    # per-layer params + pools
+            lp, kp, vp, ksp, vsp = xs          # per-layer params + pools
             iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
             hn = _nn.rms_norm(hcur, iln, epsilon=eps)
-            nh = qw.shape[1] // head_dim
-            q = jnp.matmul(hn, qw).reshape(b, nh, head_dim)
-            k = jnp.matmul(hn, kw).reshape(b, kvh, head_dim)
-            v = jnp.matmul(hn, vw).reshape(b, kvh, head_dim)
+            nh = _wout(qw) // head_dim
+            q = _mm(hn, qw).reshape(b, nh, head_dim)
+            k = _mm(hn, kw).reshape(b, kvh, head_dim)
+            v = _mm(hn, vw).reshape(b, kvh, head_dim)
             qf = q.astype(jnp.float32)
             kf = k.astype(jnp.float32)
             q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
             k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
-            attn, kp, vp = append_attend(q, kp, vp, k, v, tables, lens)
-            hcur = hcur + jnp.matmul(attn.reshape(b, nh * head_dim), ow)
+            if ksp is None:
+                attn, kp, vp = append_attend(q, kp, vp, k, v, tables,
+                                             lens)
+            else:
+                # int8 pools ride the same fused kernel with their
+                # per-token scale rows ([KVH, n_pages, 1, P] views)
+                attn, kp, vp, ks4, vs4 = append_attend(
+                    q, kp, vp, k, v, tables, lens,
+                    ksp[:, :, None, :], vsp[:, :, None, :])
+                ksp = ks4.reshape(ksp.shape)
+                vsp = vs4.reshape(vsp.shape)
+            hcur = hcur + _mm(attn.reshape(b, nh * head_dim), ow)
             hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-            ff = _nn.silu(jnp.matmul(hn, gw)) * jnp.matmul(hn, uw)
-            return hcur + jnp.matmul(ff, dw), (kp, vp)
+            ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
+            return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
 
-        x, (k_pages, v_pages) = jax.lax.scan(
-            layer, x, (tuple(stack), k_pages, v_pages))
+        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            layer, x, (tuple(stack), k_pages, v_pages, k_scales,
+                       v_scales))
         x = _nn.rms_norm(x, norm_w, epsilon=eps)
-        logits = jnp.matmul(x, head_w.T if transpose_head else head_w)
+        logits = jnp.matmul(x, head_w.T) if transpose_head \
+            else _mm(x, head_w)
         key, sub = jax.random.split(key)
         nxt, _ = sample_logits(logits, sub, strategy=strategy,
                                top_k=top_k, top_p=top_p,
                                temperature=temperature)
-        return (nxt, positions + 1, lens + 1, k_pages, v_pages, key)
+        return (nxt, positions + 1, lens + 1, k_pages, v_pages,
+                k_scales, v_scales, key)
 
     if n_steps == 1:
-        nxt, _, _, k_pages, v_pages, _ = one_token(
-            (tokens, positions, lens, k_pages, v_pages, key))
-        return nxt[None], k_pages, v_pages
+        (nxt, _, _, k_pages, v_pages, k_scales, v_scales, _) = one_token(
+            (tokens, positions, lens, k_pages, v_pages, k_scales,
+             v_scales, key))
+        return nxt[None], k_pages, v_pages, k_scales, v_scales
 
     def body(carry, _):
         carry = one_token(carry)
         return carry, carry[0]
 
-    (_, _, _, k_pages, v_pages, _), toks = jax.lax.scan(
-        body, (tokens, positions, lens, k_pages, v_pages, key),
-        None, length=n_steps)
-    return toks, k_pages, v_pages
+    ((_, _, _, k_pages, v_pages, k_scales, v_scales, _), toks) = \
+        jax.lax.scan(
+            body, (tokens, positions, lens, k_pages, v_pages, k_scales,
+                   v_scales, key),
+            None, length=n_steps)
+    return toks, k_pages, v_pages, k_scales, v_scales
 
 
 class LLMEngine:
@@ -262,13 +347,23 @@ class LLMEngine:
                  dtype=np.float32, decode_strategy: str = "greedy_search",
                  top_k: int = 0, top_p: float = 1.0,
                  temperature: float = 1.0, seed: int = 0,
-                 steps_per_sync: int = 1):
+                 steps_per_sync: int = 1,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         import jax
         import jax.numpy as jnp
+
+        from ..quantization.layers import QuantizedLinear
+        from ..quantization.ops import quantize_absmax_raw
 
         enforce(decode_strategy in ("greedy_search", "sampling"),
                 f"unsupported decode_strategy {decode_strategy!r}")
         enforce(steps_per_sync >= 1, "steps_per_sync must be >= 1")
+        enforce(kv_dtype in (None, "int8", "float32", "bfloat16",
+                             "float16"),
+                f"unsupported kv_dtype {kv_dtype!r}")
+        enforce(weight_dtype in (None, "int8"),
+                f"unsupported weight_dtype {weight_dtype!r}")
         self.steps_per_sync = steps_per_sync
         self.decode_strategy = decode_strategy
         self.top_k = int(top_k)
@@ -278,6 +373,8 @@ class LLMEngine:
         self.model = model
         self.max_seqs = max_seqs
         self.max_len = max_len
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
         c = model.config
         self.eps = c.rms_norm_eps
         self.kvh = c.num_key_value_heads
@@ -285,30 +382,60 @@ class LLMEngine:
         layers = model.llama.layers
         if n_pages is None:
             n_pages = max_seqs * (max_len // page_size) + 1
+        if kv_dtype not in (None, "int8"):
+            dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                     "float16": jnp.float16}[kv_dtype]
         self.cache = PagedKVCache(
             n_pages=n_pages, page_size=page_size, n_kv_heads=self.kvh,
             head_dim=self.head_dim, max_seqs=max_seqs, max_len=max_len,
-            dtype=dtype, num_layers=len(layers))
+            dtype=dtype, num_layers=len(layers),
+            kv_dtype="int8" if kv_dtype == "int8" else None)
 
         def stackp(get):
             return jnp.stack([get(l).value for l in layers])
+
+        def stackw(get):
+            """Stack one projection across layers: fp array, or
+            (int8 values, f32 scales) when the model's Linears were
+            quantize_model'd or weight_dtype='int8' asks for it."""
+            mods = [get(l) for l in layers]
+            if any(isinstance(m, QuantizedLinear) for m in mods):
+                enforce(all(isinstance(m, QuantizedLinear)
+                            for m in mods),
+                        "mixed fp/int8 Linears across decoder layers")
+                return (jnp.stack([m.qweight.value for m in mods]),
+                        jnp.stack([m.weight_scale.value
+                                   for m in mods]))
+            ws = jnp.stack([m.weight.value for m in mods])
+            if weight_dtype == "int8":
+                # per-(layer, out-channel) absmax over the in axis
+                return quantize_absmax_raw(ws, axis=1)
+            return ws
         self._stack = (
             stackp(lambda l: l.input_layernorm.weight),
-            stackp(lambda l: l.self_attn.q_proj.weight),
-            stackp(lambda l: l.self_attn.k_proj.weight),
-            stackp(lambda l: l.self_attn.v_proj.weight),
-            stackp(lambda l: l.self_attn.o_proj.weight),
+            stackw(lambda l: l.self_attn.q_proj),
+            stackw(lambda l: l.self_attn.k_proj),
+            stackw(lambda l: l.self_attn.v_proj),
+            stackw(lambda l: l.self_attn.o_proj),
             stackp(lambda l: l.post_attention_layernorm.weight),
-            stackp(lambda l: l.mlp.gate_proj.weight),
-            stackp(lambda l: l.mlp.up_proj.weight),
-            stackp(lambda l: l.mlp.down_proj.weight),
+            stackw(lambda l: l.mlp.gate_proj),
+            stackw(lambda l: l.mlp.up_proj),
+            stackw(lambda l: l.mlp.down_proj),
         )
         self._norm_w = model.llama.norm.weight.value
         # tied embeddings: keep the [V, H] weight and transpose in-graph
         # (an eager .T would hold a duplicate of the full vocab matrix)
         self._tied = model.lm_head is None
-        self._head_w = model.lm_head.weight.value if not self._tied \
-            else model.llama.embed_tokens.weight.value
+        if self._tied:
+            self._head_w = model.llama.embed_tokens.weight.value
+        elif isinstance(model.lm_head, QuantizedLinear):
+            self._head_w = (model.lm_head.qweight.value,
+                            model.lm_head.weight_scale.value)
+        elif weight_dtype == "int8":
+            self._head_w = quantize_absmax_raw(
+                model.lm_head.weight.value, axis=0)
+        else:
+            self._head_w = model.lm_head.weight.value
         self._embed_w = model.llama.embed_tokens.weight.value
         rope = np.asarray(model.llama.rope_cos.value), \
             np.asarray(model.llama.rope_sin.value)
@@ -375,12 +502,14 @@ class LLMEngine:
             real = min(P, plen - base)
             chunk[:real] = np.asarray(req.prompt[base:base + real],
                                       np.int32)
-            logits, self.cache.k_pages, self.cache.v_pages = \
+            (logits, self.cache.k_pages, self.cache.v_pages,
+             self.cache.k_scales, self.cache.v_scales) = \
                 _paged_prefill_chunk(
                     self._stack, self._norm_w, self._head_w,
                     self._embed_w, self._rope_prefill,
-                    self.cache.k_pages,
-                    self.cache.v_pages, jnp.asarray(chunk),
+                    self.cache.k_pages, self.cache.v_pages,
+                    self.cache.k_scales, self.cache.v_scales,
+                    jnp.asarray(chunk),
                     jnp.asarray(table), jnp.int32(base),
                     jnp.int32(int(table[ci])),
                     jnp.int32(min(plen - 1 - base, P - 1)),
@@ -448,9 +577,11 @@ class LLMEngine:
                       np.int32)])
 
         self._key, sub = jax.random.split(self._key)
-        toks, self.cache.k_pages, self.cache.v_pages = _paged_decode_step(
+        (toks, self.cache.k_pages, self.cache.v_pages,
+         self.cache.k_scales, self.cache.v_scales) = _paged_decode_step(
             self._stack, self._norm_w, self._head_w, self._embed_w,
             self._rope, self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales,
             jnp.asarray(tokens), jnp.asarray(lens, np.int32),
             jnp.asarray(tables), jnp.asarray(lens, np.int32), sub,
             eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
@@ -492,7 +623,9 @@ class LLMEngine:
     def prefill_compiles() -> int:
         """Number of distinct prefill XLA programs compiled — 1 for
         any request mix (the chunked program's shape is fixed by the
-        engine geometry, not the prompt lengths)."""
+        engine geometry, not the prompt lengths; the int8 KV / int8
+        weight variants are distinct engine CONFIGS, not request
+        shapes, so each engine still sees exactly one)."""
         return _paged_prefill_chunk._cache_size()
 
     @staticmethod

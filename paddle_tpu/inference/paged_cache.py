@@ -10,6 +10,13 @@ consumed by ops.pallas.paged_attention.
 One object manages ALL decoder layers (``num_layers`` pools sharing one
 page table): a token occupies the same (page, slot) in every layer, the
 length advances once per token — per-layer bookkeeping cannot drift.
+
+``kv_dtype="int8"`` stores the pools quantized (per-token absmax, one
+f32 scale per row kept in sibling scale pools [L, KVH, n_pages, P]):
+write_prefill/append quantize on the way in, attend dequantizes inside
+the kernel — KV HBM bytes drop ~2× vs fp16 / ~4× vs fp32, which is the
+whole game for bandwidth-bound TPU decode and for page capacity at a
+fixed HBM budget.
 """
 from __future__ import annotations
 
@@ -25,16 +32,30 @@ __all__ = ["PagedKVCache"]
 class PagedKVCache:
     def __init__(self, n_pages: int, page_size: int, n_kv_heads: int,
                  head_dim: int, max_seqs: int, max_len: int,
-                 dtype=np.float32, num_layers: int = 1):
+                 dtype=np.float32, num_layers: int = 1,
+                 kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
+        enforce(kv_dtype in (None, "int8"),
+                f"unsupported kv_dtype {kv_dtype!r} (None or 'int8')")
         self.n_pages = n_pages
         self.page_size = page_size
         self.num_layers = num_layers
+        self.kv_dtype = kv_dtype
         self.max_pages_per_seq = (max_len + page_size - 1) // page_size
+        pool_dtype = jnp.int8 if kv_dtype == "int8" else dtype
         # [L, KVH, n_pages, P, D]
         self.k_pages = jnp.zeros((num_layers, n_kv_heads, n_pages,
-                                  page_size, head_dim), dtype)
+                                  page_size, head_dim), pool_dtype)
         self.v_pages = jnp.zeros_like(self.k_pages)
+        if kv_dtype == "int8":
+            # per-token dequant scales; the kernels consume per-layer
+            # [KVH, n_pages, 1, P] views (scale vector on the lanes)
+            self.k_scales = jnp.zeros((num_layers, n_kv_heads, n_pages,
+                                       page_size), jnp.float32)
+            self.v_scales = jnp.zeros_like(self.k_scales)
+        else:
+            self.k_scales = None
+            self.v_scales = None
         self._free = list(range(n_pages - 1, 0, -1))   # page 0 = pad
         self._pages: Dict[int, List[int]] = {}
         self._lens = np.zeros(max_seqs, np.int32)
@@ -99,6 +120,18 @@ class PagedKVCache:
     def free_page_count(self) -> int:
         return len(self._free)
 
+    def kv_bytes_per_token(self) -> int:
+        """HBM bytes one cached token costs across all layers and both
+        pools — int8 counts its f32 scale rows, so capacity claims stay
+        honest."""
+        head_dim = self.k_pages.shape[-1]
+        kvh = self.k_pages.shape[1]
+        if self.kv_dtype == "int8":
+            per_row = head_dim * 1 + 4          # int8 values + f32 scale
+        else:
+            per_row = head_dim * self.k_pages.dtype.itemsize
+        return 2 * self.num_layers * kvh * per_row
+
     # -- device-side ops -------------------------------------------------------
     def _norm_layers(self, k, v, tokens_axis: int):
         """Accept [S?, KVH, D]-style per-layer input when num_layers==1,
@@ -114,7 +147,8 @@ class PagedKVCache:
 
     def write_prefill(self, slot: int, k, v):
         """Bulk-write a prefill's keys/values into the sequence's pages
-        with ONE vectorized scatter per pool.
+        with ONE vectorized scatter per pool (int8 mode quantizes the
+        rows on the way in and scatters the scales alongside).
 
         k/v: [S, KVH, D] (num_layers==1) or [L, S, KVH, D]."""
         import jax.numpy as jnp
@@ -126,8 +160,17 @@ class PagedKVCache:
         pages = jnp.asarray(self._table[slot, pos // self.page_size])
         slots_ = jnp.asarray(pos % self.page_size)
         # [L, S, KVH, D] -> [L, KVH, S, D] scatter at (pages, slots)
-        kt = jnp.swapaxes(k, 1, 2).astype(self.k_pages.dtype)
-        vt = jnp.swapaxes(v, 1, 2).astype(self.v_pages.dtype)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        if self.kv_dtype == "int8":
+            from ..quantization.ops import quantize_rows_raw
+            kt, ksc = quantize_rows_raw(kt)       # + [L, KVH, S] scales
+            vt, vsc = quantize_rows_raw(vt)
+            self.k_scales = self.k_scales.at[:, :, pages, slots_].set(ksc)
+            self.v_scales = self.v_scales.at[:, :, pages, slots_].set(vsc)
+        else:
+            kt = kt.astype(self.k_pages.dtype)
+            vt = vt.astype(self.v_pages.dtype)
         self.k_pages = self.k_pages.at[:, :, pages, slots_, :].set(kt)
         self.v_pages = self.v_pages.at[:, :, pages, slots_, :].set(vt)
         self._lens[slot] = start + s
@@ -149,8 +192,19 @@ class PagedKVCache:
         # copies its output), so a per-layer dus chain would copy the
         # pool 2·L·B times per token; the jit-compiled serving path
         # (engine's fused append+attend kernel) never comes through here
-        kt = jnp.swapaxes(k_new, 1, 2).astype(self.k_pages.dtype)
-        vt = jnp.swapaxes(v_new, 1, 2).astype(self.v_pages.dtype)
+        kt = jnp.swapaxes(k_new, 1, 2)
+        vt = jnp.swapaxes(v_new, 1, 2)
+        if self.kv_dtype == "int8":
+            from ..quantization.ops import quantize_rows_raw
+            kt, ksc = quantize_rows_raw(kt)       # + [L, KVH, B] scales
+            vt, vsc = quantize_rows_raw(vt)
+            self.k_scales = self.k_scales.at[
+                :, :, pages, slot_in_page].set(ksc)
+            self.v_scales = self.v_scales.at[
+                :, :, pages, slot_in_page].set(vsc)
+        else:
+            kt = kt.astype(self.k_pages.dtype)
+            vt = vt.astype(self.v_pages.dtype)
         self.k_pages = self.k_pages.at[:, :, pages, slot_in_page, :].set(kt)
         self.v_pages = self.v_pages.at[:, :, pages, slot_in_page, :].set(vt)
         self.advance(slots, 1)
@@ -158,7 +212,9 @@ class PagedKVCache:
     def attend(self, slots, q, layer: int = 0,
                use_kernel: Optional[bool] = None):
         """Decode attention for ``q`` [B, H, D] over the cached pages of
-        ``slots`` in ``layer``.  Kernel on TPU, jnp reference elsewhere."""
+        ``slots`` in ``layer``.  Kernel on TPU, jnp reference elsewhere;
+        int8 pools hand the kernel their per-token scales and dequantize
+        in VMEM."""
         import jax.numpy as jnp
         from ..runtime.device import is_compiled_with_tpu
         from ..ops.pallas.paged_attention import (paged_attention_raw,
@@ -170,5 +226,9 @@ class PagedKVCache:
             use_kernel = is_compiled_with_tpu()
         fn = paged_attention_raw if use_kernel else \
             paged_attention_reference
+        args = ()
+        if self.kv_dtype == "int8":
+            args = (self.k_scales[layer][:, :, None, :],
+                    self.v_scales[layer][:, :, None, :])
         return fn(jnp.asarray(q), self.k_pages[layer],
-                  self.v_pages[layer], table, lens)
+                  self.v_pages[layer], table, lens, *args)

@@ -22,6 +22,16 @@ this design is what the ragged-paged-attention paper's kernel does and
 measures ~30× faster (see BASELINE.md serving rows).  The query-head
 group of each KV head (GQA) rides the same page DMA; pages past a
 sequence's length are never copied.
+
+INT8 KV mode (the quantization subsystem's serving path): pages are
+stored int8 with ONE f32 absmax scale per token row, kept in a sibling
+scale pool laid out [KVH, n_pages, 1, page_size] — the page's scale
+vector lives on the LANE dimension, so in-kernel dequantization never
+needs a sublane broadcast: the K scale multiplies the logits row
+s[g, t] (shape [G, P] × [1, P]) and the V scale folds into the softmax
+probabilities before the PV matmul.  The int8 page + its scale row
+stream through the same _NBUF-deep DMA pipeline; HBM traffic per page
+drops ~2× vs fp16 (page bytes P·D → P·D + 4·P for the scales).
 """
 from __future__ import annotations
 
@@ -32,10 +42,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...quantization.ops import EPS, QMAX, quantize_rows_raw
 from .vma import out_sds
 
 __all__ = ["paged_attention_raw", "paged_attention_reference",
-           "paged_write", "paged_decode_append_attend",
+           "paged_write", "paged_write_quant",
+           "paged_decode_append_attend",
            "paged_decode_append_attend_reference"]
 
 _NEG_INF = float(-1e30)
@@ -46,13 +58,25 @@ _NBUF = 8          # DMA pipeline depth: outstanding page copies per stream
 
 
 def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,
-                  length, npages, page_size, inject=None):
+                  length, npages, page_size, inject=None, quant=None):
     """Online-softmax attention over a sequence's pages, streamed from
-    HBM with an _NBUF-deep manual DMA pipeline.  ``inject``: optional
-    (append_page, append_slot, k_row [D], v_row [D]) — substituted into
-    the streamed page in registers, and the modified page handed to the
-    caller through the returned ``wpage`` (k_mod, v_mod) pair for
-    write-back.  Returns (l, acc, kmod, vmod)."""
+    HBM with an _NBUF-deep manual DMA pipeline.
+
+    ``inject``: optional append substitution performed in registers —
+    fp mode (append_page, append_slot, k_row [D], v_row [D]); int8 mode
+    additionally carries the pre-quantized row and its scales
+    (append_page, append_slot, k_row_q [D] i8, v_row_q [D] i8,
+    k_scale, v_scale).  The modified page (and, in int8 mode, its
+    modified scale row) is handed back for write-back.
+
+    ``quant``: (ks_hbm, vs_hbm, ks_scr, vs_scr) — int8 pages with
+    per-token scale rows [1, P] streamed alongside each page;
+    ``sem`` then has 4 columns (k, v, k-scale, v-scale).
+
+    Returns (l, acc, writeback) where writeback is None, (kmod, vmod),
+    or (kmod, vmod, ksmod, vsmod)."""
+    if quant is not None:
+        ks_hbm, vs_hbm, ks_scr, vs_scr = quant
 
     def k_copy(i, slot):
         return pltpu.make_async_copy(
@@ -62,11 +86,32 @@ def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,
         return pltpu.make_async_copy(
             v_hbm.at[h, pt_ref[b, i]], v_scr.at[slot], sem.at[slot, 1])
 
+    def ks_copy(i, slot):
+        return pltpu.make_async_copy(
+            ks_hbm.at[h, pt_ref[b, i]], ks_scr.at[slot], sem.at[slot, 2])
+
+    def vs_copy(i, slot):
+        return pltpu.make_async_copy(
+            vs_hbm.at[h, pt_ref[b, i]], vs_scr.at[slot], sem.at[slot, 3])
+
+    def start(i, slot):
+        k_copy(i, slot).start()
+        v_copy(i, slot).start()
+        if quant is not None:
+            ks_copy(i, slot).start()
+            vs_copy(i, slot).start()
+
+    def wait(i, slot):
+        k_copy(i, slot).wait()
+        v_copy(i, slot).wait()
+        if quant is not None:
+            ks_copy(i, slot).wait()
+            vs_copy(i, slot).wait()
+
     for j in range(_NBUF):
         @pl.when(j < npages)
         def _(j=j):
-            k_copy(j, j).start()
-            v_copy(j, j).start()
+            start(j, j)
 
     g = q.shape[0]
     d = q.shape[1]
@@ -75,28 +120,48 @@ def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,
     acc0 = jnp.zeros((g, d), jnp.float32)
 
     def body(i, carry):
-        if inject is not None:
+        if inject is not None and quant is not None:
+            m, l, acc, kmod, vmod, ksmod, vsmod = carry
+        elif inject is not None:
             m, l, acc, kmod, vmod = carry
         else:
             m, l, acc = carry
         slot = jax.lax.rem(i, _NBUF)
 
-        k_copy(i, slot).wait()
-        v_copy(i, slot).wait()
-        k = k_scr[slot].astype(jnp.float32)                # [P, D]
-        v = v_scr[slot].astype(jnp.float32)
+        wait(i, slot)
+        kpg = k_scr[slot]                                  # [P, D]
+        vpg = v_scr[slot]
+        if quant is not None:
+            ks = ks_scr[slot]                              # [1, P] f32
+            vs = vs_scr[slot]
         if inject is not None:
-            ap, aslot, krow, vrow = inject
+            if quant is not None:
+                ap, aslot, krow, vrow, ksrow, vsrow = inject
+            else:
+                ap, aslot, krow, vrow = inject
             hit = i == ap
             rowsel = jax.lax.broadcasted_iota(
                 jnp.int32, (page_size, 1), 0) == aslot
             sel = jnp.logical_and(hit, rowsel)
-            k = jnp.where(sel, krow[None, :], k)
-            v = jnp.where(sel, vrow[None, :], v)
-            kmod = jnp.where(hit, k, kmod)
-            vmod = jnp.where(hit, v, vmod)
+            kpg = jnp.where(sel, krow[None, :], kpg)
+            vpg = jnp.where(sel, vrow[None, :], vpg)
+            kmod = jnp.where(hit, kpg, kmod)
+            vmod = jnp.where(hit, vpg, vmod)
+            if quant is not None:
+                lanesel = jax.lax.broadcasted_iota(
+                    jnp.int32, (1, page_size), 1) == aslot
+                lsel = jnp.logical_and(hit, lanesel)
+                ks = jnp.where(lsel, ksrow, ks)
+                vs = jnp.where(lsel, vsrow, vs)
+                ksmod = jnp.where(hit, ks, ksmod)
+                vsmod = jnp.where(hit, vs, vsmod)
+        k = kpg.astype(jnp.float32)
+        v = vpg.astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if quant is not None:
+            # per-token K scale lands on the logit LANES: [G,P] * [1,P]
+            s = s * ks
         pos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(pos < length, s, _NEG_INF)
@@ -104,29 +169,48 @@ def _stream_pages(pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem,
         p = jnp.exp(s - m_new)                             # [G, P]
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if quant is not None:
+            # fold V's per-token scale into the probabilities (lanes
+            # again), so the PV matmul consumes the raw int8 page
+            p = p * vs
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
 
         # refill this slot only after the dots consumed its data
         @pl.when(i + _NBUF < npages)
         def _():
-            k_copy(i + _NBUF, slot).start()
-            v_copy(i + _NBUF, slot).start()
+            start(i + _NBUF, slot)
+        if inject is not None and quant is not None:
+            return (m_new, l_new, acc * alpha + pv, kmod, vmod,
+                    ksmod, vsmod)
         if inject is not None:
             return m_new, l_new, acc * alpha + pv, kmod, vmod
         return m_new, l_new, acc * alpha + pv
 
     if inject is not None:
-        kz = jnp.zeros((page_size, d), jnp.float32)
+        kz = jnp.zeros((page_size, d),
+                       jnp.int8 if quant is not None else jnp.float32)
+        if quant is not None:
+            sz = jnp.zeros((1, page_size), jnp.float32)
+            _, l, acc, kmod, vmod, ksmod, vsmod = jax.lax.fori_loop(
+                0, npages, body, (m0, l0, acc0, kz, kz, sz, sz))
+            return l, acc, (kmod, vmod, ksmod, vsmod)
         _, l, acc, kmod, vmod = jax.lax.fori_loop(
             0, npages, body, (m0, l0, acc0, kz, kz))
-        return l, acc, kmod, vmod
+        return l, acc, (kmod, vmod)
     _, l, acc = jax.lax.fori_loop(0, npages, body, (m0, l0, acc0))
-    return l, acc, None, None
+    return l, acc, None
 
 
-def _decode_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
-                   k_scr, v_scr, sem, *, scale, page_size, maxp):
+def _decode_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, *rest,
+                   scale, page_size, maxp, quantized):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref,
+         k_scr, v_scr, sem, ks_scr, vs_scr) = rest
+        quant = (ks_hbm, vs_hbm, ks_scr, vs_scr)
+    else:
+        o_ref, k_scr, v_scr, sem = rest
+        quant = None
     b, h = pl.program_id(0), pl.program_id(1)
     length = len_ref[b]
     npages = jnp.minimum((length + page_size - 1) // page_size, maxp)
@@ -138,24 +222,29 @@ def _decode_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
     @pl.when(npages > 0)
     def _():
         q = q_ref[0, 0].astype(jnp.float32) * scale        # [G, D]
-        l, acc, _, _ = _stream_pages(
+        l, acc, _ = _stream_pages(
             pt_ref, b, h, q, k_hbm, v_hbm, k_scr, v_scr, sem, length,
-            npages, page_size)
+            npages, page_size, quant=quant)
         o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale",))
-def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
-                        scale=None):
+def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens,
+                        k_scales=None, v_scales=None, *, scale=None):
     """Single-token (decode) ragged paged attention.
 
     q:          [B, H, D] — one query token per sequence.
-    k_pages:    [KVH, n_pages, page_size, D] physical page pool.
+    k_pages:    [KVH, n_pages, page_size, D] physical page pool
+                (fp, or int8 when k_scales/v_scales are given).
     v_pages:    like k_pages.
     page_table: [B, max_pages] int32 — physical page per logical slot
                 (entries past a sequence's page count must still be
                 valid indices; their keys are masked by seq_lens).
     seq_lens:   [B] int32 — valid tokens per sequence.
+    k_scales/v_scales: optional [KVH, n_pages, 1, page_size] f32
+                per-token dequantization scales for int8 pools; the
+                kernel dequantizes in VMEM (pages never round-trip
+                through a dense fp copy).
 
     Returns [B, H, D].
     """
@@ -166,43 +255,61 @@ def paged_attention_raw(q, k_pages, v_pages, page_table, seq_lens, *,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     qg = q.reshape(b, kvh, g, d)
+    quantized = k_scales is not None
 
     grid = (b, kvh)
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               page_size=page_size, maxp=maxp)
+                               page_size=page_size, maxp=maxp,
+                               quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+        # page pools stay in HBM; the kernel streams pages with
+        # manual double-buffered async copies
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
+        pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
+        pltpu.SemaphoreType.DMA((_NBUF, 4 if quantized else 2)),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                     pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch += [pltpu.VMEM((_NBUF, 1, page_size), jnp.float32),
+                    pltpu.VMEM((_NBUF, 1, page_size), jnp.float32)]
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d),
-                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
-                # page pools stay in HBM; the kernel streams pages with
-                # manual double-buffered async copies
-                pl.BlockSpec(memory_space=pltpu.ANY),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, g, d),
                                    lambda b_, h_, pt, ln: (b_, h_,
                                                            0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
-                pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((_NBUF, 2)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=out_sds((b, kvh, g, d), q.dtype, page_table,
-                          seq_lens, qg, k_pages, v_pages),
+                          seq_lens, *operands),
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      qg, k_pages, v_pages)
+      *operands)
     return out.reshape(b, h, d)
 
 
 def _decode_append_kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
-                          k_in, v_in, o_ref, k_out, v_out,
-                          k_scr, v_scr, w_scr, sem, wsem,
-                          *, scale, page_size, maxp):
+                          k_in, v_in, *rest,
+                          scale, page_size, maxp, quantized):
+    if quantized:
+        (ks_in, vs_in, o_ref, k_out, v_out, ks_out, vs_out,
+         k_scr, v_scr, w_scr, sem, wsem, ks_scr, vs_scr,
+         ws_scr) = rest
+        quant = (ks_in, vs_in, ks_scr, vs_scr)
+    else:
+        (o_ref, k_out, v_out, k_scr, v_scr, w_scr, sem, wsem) = rest
+        quant = None
     b, h = pl.program_id(0), pl.program_id(1)
     pos = len_ref[b]                        # append position
     length = pos + 1                        # attend incl. the new token
@@ -217,32 +324,66 @@ def _decode_append_kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
                    axis=0)                                  # [D]
     vrow = jnp.sum(jnp.where(hsel, vnew_ref[0].astype(jnp.float32), 0.0),
                    axis=0)
+    if quantized:
+        # quantize the appended rows in registers: one absmax scale
+        # per row (the pool's per-token granularity)
+        kamax = jnp.maximum(jnp.max(jnp.abs(krow)), EPS)
+        vamax = jnp.maximum(jnp.max(jnp.abs(vrow)), EPS)
+        ksrow = kamax / QMAX
+        vsrow = vamax / QMAX
+        krow = jnp.clip(jnp.round(krow / ksrow), -QMAX,
+                        QMAX).astype(jnp.int8)
+        vrow = jnp.clip(jnp.round(vrow / vsrow), -QMAX,
+                        QMAX).astype(jnp.int8)
+        inject = (ap, aslot, krow, vrow, ksrow, vsrow)
+    else:
+        inject = (ap, aslot, krow, vrow)
 
     q = q_ref[0, 0].astype(jnp.float32) * scale             # [G, D]
-    l, acc, kmod, vmod = _stream_pages(
+    l, acc, wb = _stream_pages(
         pt_ref, b, h, q, k_in, v_in, k_scr, v_scr, sem, length, npages,
-        page_size, inject=(ap, aslot, krow, vrow))
+        page_size, inject=inject, quant=quant)
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
     # write the modified append page back with ONE full-page DMA (the
     # row-granular write is a register select above — no sublane-
     # alignment constraints, unlike a direct scatter/partial DMA)
+    if quantized:
+        kmod, vmod, ksmod, vsmod = wb
+    else:
+        kmod, vmod = wb
     w_scr[0] = kmod.astype(w_scr.dtype)
     w_scr[1] = vmod.astype(w_scr.dtype)
-    kw = pltpu.make_async_copy(w_scr.at[0], k_out.at[h, pt_ref[b, ap]],
-                               wsem.at[0])
-    vw = pltpu.make_async_copy(w_scr.at[1], v_out.at[h, pt_ref[b, ap]],
-                               wsem.at[1])
-    kw.start()
-    vw.start()
-    kw.wait()
-    vw.wait()
+    copies = [
+        pltpu.make_async_copy(w_scr.at[0], k_out.at[h, pt_ref[b, ap]],
+                              wsem.at[0]),
+        pltpu.make_async_copy(w_scr.at[1], v_out.at[h, pt_ref[b, ap]],
+                              wsem.at[1]),
+    ]
+    if quantized:
+        ws_scr[0] = ksmod
+        ws_scr[1] = vsmod
+        copies += [
+            pltpu.make_async_copy(ws_scr.at[0],
+                                  ks_out.at[h, pt_ref[b, ap]],
+                                  wsem.at[2]),
+            pltpu.make_async_copy(ws_scr.at[1],
+                                  vs_out.at[h, pt_ref[b, ap]],
+                                  wsem.at[3]),
+        ]
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("scale",),
-                   donate_argnums=(1, 2))
+                   donate_argnames=("k_pages", "v_pages",
+                                    "k_scales", "v_scales"))
 def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
-                               page_table, seq_lens, *, scale=None):
+                               page_table, seq_lens,
+                               k_scales=None, v_scales=None, *,
+                               scale=None):
     """Fused decode step: append ``k_new``/``v_new`` [B, KVH, D] at
     position ``seq_lens[b]`` AND attend ``q`` [B, H, D] over the
     ``seq_lens[b] + 1`` tokens, in ONE kernel.
@@ -251,8 +392,14 @@ def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
     writes are one modified page per (sequence, kv-head) — the XLA
     ``paged_write`` scatter/dus path rewrites the whole pool per step
     on TPU (dynamic sublane offsets defeat in-place updates) and was
-    the round-3 serving bottleneck.  Returns (out [B, H, D], k_pages',
-    v_pages'); caller bumps seq_lens.
+    the round-3 serving bottleneck.
+
+    With ``k_scales``/``v_scales`` ([KVH, n_pages, 1, P] f32) the pools
+    are int8: the kernel quantizes the appended rows in registers,
+    streams + dequantizes pages in VMEM, and writes back the modified
+    int8 page together with its scale row.  Returns
+    (out [B, H, D], k_pages', v_pages') — plus (k_scales', v_scales')
+    in int8 mode; caller bumps seq_lens.
     """
     b, h, d = q.shape
     kvh, n_pages, page_size, _ = k_pages.shape
@@ -261,53 +408,92 @@ def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     qg = q.reshape(b, kvh, g, d)
+    quantized = k_scales is not None
 
     kernel = functools.partial(_decode_append_kernel, scale=scale,
-                               page_size=page_size, maxp=maxp)
-    out, kp, vp = pl.pallas_call(
+                               page_size=page_size, maxp=maxp,
+                               quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, kvh, d),
+                     lambda b_, h_, pt, ln: (b_, 0, 0)),
+        pl.BlockSpec((1, kvh, d),
+                     lambda b_, h_, pt, ln: (b_, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, g, d),
+                     lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
+        pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
+        pltpu.VMEM((2, page_size, d), k_pages.dtype),
+        pltpu.SemaphoreType.DMA((_NBUF, 4 if quantized else 2)),
+        pltpu.SemaphoreType.DMA((4 if quantized else 2,)),
+    ]
+    # new K/V rows are passed fp even in int8 mode (the kernel
+    # quantizes them in registers)
+    operands = [qg, k_new.astype(jnp.float32 if quantized
+                                 else k_pages.dtype),
+                v_new.astype(jnp.float32 if quantized
+                             else v_pages.dtype),
+                k_pages, v_pages]
+    out_shape = [
+        out_sds((b, kvh, g, d), q.dtype, qg, k_pages, v_pages),
+        out_sds(k_pages.shape, k_pages.dtype, qg, k_pages, v_pages),
+        out_sds(v_pages.shape, v_pages.dtype, qg, k_pages, v_pages),
+    ]
+    aliases = {5: 1, 6: 2}
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                     pl.BlockSpec(memory_space=pltpu.ANY)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                      pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch += [pltpu.VMEM((_NBUF, 1, page_size), jnp.float32),
+                    pltpu.VMEM((_NBUF, 1, page_size), jnp.float32),
+                    pltpu.VMEM((2, 1, page_size), jnp.float32)]
+        operands += [k_scales, v_scales]
+        out_shape += [
+            out_sds(k_scales.shape, k_scales.dtype, qg, k_scales),
+            out_sds(v_scales.shape, v_scales.dtype, qg, v_scales),
+        ]
+        aliases = {5: 1, 6: 2, 7: 3, 8: 4}
+    outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, kvh),
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d),
-                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
-                pl.BlockSpec((1, kvh, d),
-                             lambda b_, h_, pt, ln: (b_, 0, 0)),
-                pl.BlockSpec((1, kvh, d),
-                             lambda b_, h_, pt, ln: (b_, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, 1, g, d),
-                             lambda b_, h_, pt, ln: (b_, h_, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((_NBUF, page_size, d), k_pages.dtype),
-                pltpu.VMEM((_NBUF, page_size, d), v_pages.dtype),
-                pltpu.VMEM((2, page_size, d), k_pages.dtype),
-                pltpu.SemaphoreType.DMA((_NBUF, 2)),
-                pltpu.SemaphoreType.DMA((2,)),
-            ],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
         ),
-        out_shape=[
-            out_sds((b, kvh, g, d), q.dtype, qg, k_pages, v_pages),
-            out_sds(k_pages.shape, k_pages.dtype, qg, k_pages, v_pages),
-            out_sds(v_pages.shape, v_pages.dtype, qg, k_pages, v_pages),
-        ],
-        input_output_aliases={5: 1, 6: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      qg, k_new.astype(k_pages.dtype), v_new.astype(v_pages.dtype),
-      k_pages, v_pages)
+      *operands)
+    if quantized:
+        out, kp, vp, ks, vs = outs
+        return out.reshape(b, h, d), kp, vp, ks, vs
+    out, kp, vp = outs
     return out.reshape(b, h, d), kp, vp
 
 
 def paged_decode_append_attend_reference(q, k_pages, v_pages, k_new,
-                                         v_new, page_table, seq_lens):
-    """jnp oracle / CPU path for the fused decode step."""
+                                         v_new, page_table, seq_lens,
+                                         k_scales=None, v_scales=None):
+    """jnp oracle / CPU path for the fused decode step (fp and int8)."""
+    if k_scales is not None:
+        k_pages, v_pages, k_scales, v_scales = paged_write_quant(
+            k_pages, v_pages, k_scales, v_scales, k_new, v_new,
+            page_table, seq_lens)
+        out = paged_attention_reference(q, k_pages, v_pages, page_table,
+                                        seq_lens + 1, k_scales, v_scales)
+        return out, k_pages, v_pages, k_scales, v_scales
     k_pages, v_pages = paged_write(k_pages, v_pages, k_new, v_new,
                                    page_table, seq_lens)
     out = paged_attention_reference(q, k_pages, v_pages, page_table,
@@ -315,9 +501,12 @@ def paged_decode_append_attend_reference(q, k_pages, v_pages, k_new,
     return out, k_pages, v_pages
 
 
-def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens):
+def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens,
+                              k_scales=None, v_scales=None):
     """jnp oracle (and CPU fallback): gather pages into dense [B, S, ...]
-    then masked attention."""
+    then masked attention.  With ``k_scales``/``v_scales`` the pools are
+    int8 and the gather dequantizes (token t of page p uses scale
+    [..., p, 0, t])."""
     b, h, d = q.shape
     kvh, _, page_size, _ = k_pages.shape
     maxp = page_table.shape[1]
@@ -325,6 +514,14 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens):
     # [B, KVH, maxp, P, D] -> [B, KVH, S, D]
     kg = jnp.swapaxes(k_pages[:, page_table], 0, 1)
     vg = jnp.swapaxes(v_pages[:, page_table], 0, 1)
+    if k_scales is not None:
+        # [B, KVH, maxp, 1, P] -> per-token column [B, KVH, maxp, P, 1]
+        ksg = jnp.swapaxes(jnp.swapaxes(k_scales[:, page_table], 0, 1),
+                           -1, -2)
+        vsg = jnp.swapaxes(jnp.swapaxes(v_scales[:, page_table], 0, 1),
+                           -1, -2)
+        kg = kg.astype(jnp.float32) * ksg
+        vg = vg.astype(jnp.float32) * vsg
     s_tot = maxp * page_size
     kg = kg.reshape(b, kvh, s_tot, d)
     vg = vg.reshape(b, kvh, s_tot, d)
@@ -364,3 +561,33 @@ def paged_write(k_pages, v_pages, k_new, v_new, page_table, seq_lens):
         v_pages = jax.lax.dynamic_update_slice(
             v_pages, vt[:, i][:, None, None, :], idx)
     return k_pages, v_pages
+
+
+def paged_write_quant(k_pages, v_pages, k_scales, v_scales,
+                      k_new, v_new, page_table, seq_lens):
+    """INT8 ``paged_write``: quantize each new row (per-token absmax)
+    on the way in, updating both the int8 pools and the scale pools
+    ([KVH, n_pages, 1, P]).  Same dus-chain shape as paged_write."""
+    page_size = k_pages.shape[2]
+    b = k_new.shape[0]
+    kq, ks = quantize_rows_raw(k_new)        # [B, KVH, D] i8, [B, KVH]
+    vq, vs = quantize_rows_raw(v_new)
+    kt = jnp.swapaxes(kq, 0, 1)                             # [KVH, B, D]
+    vt = jnp.swapaxes(vq, 0, 1)
+    kst = jnp.swapaxes(ks, 0, 1).astype(k_scales.dtype)     # [KVH, B]
+    vst = jnp.swapaxes(vs, 0, 1).astype(v_scales.dtype)
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(b):
+        page = page_table[i, seq_lens[i] // page_size]
+        slot = seq_lens[i] % page_size
+        idx = (zero, page, slot, zero)
+        k_pages = jax.lax.dynamic_update_slice(
+            k_pages, kt[:, i][:, None, None, :], idx)
+        v_pages = jax.lax.dynamic_update_slice(
+            v_pages, vt[:, i][:, None, None, :], idx)
+        sidx = (zero, page, zero, slot)
+        k_scales = jax.lax.dynamic_update_slice(
+            k_scales, kst[:, i][:, None, None, None], sidx)
+        v_scales = jax.lax.dynamic_update_slice(
+            v_scales, vst[:, i][:, None, None, None], sidx)
+    return k_pages, v_pages, k_scales, v_scales

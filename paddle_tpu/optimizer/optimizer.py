@@ -523,7 +523,11 @@ class LBFGS(Optimizer):
 
     Two-loop recursion over the last ``history_size`` (s, y) pairs on
     the FLATTENED parameter vector; line search is backtracking Armijo
-    (``line_search_fn=None``/'armijo') or strong-Wolfe zoom.  State
+    (``line_search_fn=None``/'armijo') or, with 'strong_wolfe',
+    backtracking with a Wolfe curvature check (no bracket/zoom
+    expansion: if the initial step undershoots, the curvature
+    condition may go unsatisfied and the last tried step is taken —
+    ADVICE r5 finding 2).  State
     lives on host lists (the closure re-runs eager autograd anyway, so
     there is nothing to jit here — matches the reference, whose LBFGS
     is also a host loop around the graph)."""

@@ -1,0 +1,26 @@
+"""paddle_tpu.quantization — weight-only INT8 + INT8 KV-cache serving.
+
+The subsystem has three tiers:
+
+- :mod:`~paddle_tpu.quantization.ops` — jax-level absmax
+  quantize/dequantize primitives (also consumed by the Pallas paged
+  attention kernels, which dequantize int8 pages in VMEM).
+- :mod:`~paddle_tpu.quantization.layers` — ``QuantizedLinear`` and the
+  one-call ``quantize_model`` converter for LLaMA/GPT-style decoders.
+- engine knobs — ``LLMEngine(kv_dtype="int8", weight_dtype="int8")``
+  stores KV pages as int8 with per-token scales and runs the decoder
+  matmuls against int8 weights (see ``paddle_tpu.inference.engine``).
+"""
+from .layers import QuantizedLinear, quantize_model
+from .ops import (dequantize_absmax_raw, quantize_absmax_raw,
+                  quantize_rows_raw, quantized_matmul_raw)
+from ..ops.api import tensorize
+
+# Tensor-level functional surface (auto-tensorized like the ops library)
+quantize_absmax = tensorize(quantize_absmax_raw)
+dequantize_absmax = tensorize(dequantize_absmax_raw)
+
+__all__ = ["QuantizedLinear", "quantize_model", "quantize_absmax",
+           "dequantize_absmax", "quantize_absmax_raw",
+           "dequantize_absmax_raw", "quantize_rows_raw",
+           "quantized_matmul_raw"]

@@ -1,0 +1,107 @@
+"""Weight-only INT8 layers + the one-call model converter.
+
+Reference parity: PaddleSlim-style post-training weight-only
+quantization for inference (``paddle.nn.quant`` / slim's
+quant_post_weight_only), shaped for this repo's serving stack:
+``QuantizedLinear`` stores the paddle-layout [in, out] weight as int8
+with one f32 scale per output channel; ``quantize_model`` swaps every
+``nn.Linear`` of a LLaMA/GPT-style decoder in place so the eager /
+``generate()`` paths run weight-only-int8 with no call-site changes.
+``LLMEngine(weight_dtype="int8")`` consumes the same storage (or
+quantizes fp weights itself) for the paged serving path.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..nn.common import Linear
+from ..nn.layer import Layer
+from ..tensor import Tensor, apply_op
+from .ops import dequantize_absmax_raw, quantize_absmax_raw, \
+    quantized_matmul_raw
+
+__all__ = ["QuantizedLinear", "quantize_model"]
+
+
+def _qlinear_raw(x, qw, scale, bias=None):
+    y = quantized_matmul_raw(x, qw, scale)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+class QuantizedLinear(Layer):
+    """y = x @ dequant(W_int8) + b; storage is int8 [in, out] plus one
+    f32 scale per output channel (symmetric absmax).  Inference-only:
+    the int8 weight takes no gradient."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.register_buffer(
+            "qweight", Tensor(np.zeros((in_features, out_features),
+                                       np.int8)))
+        self.register_buffer(
+            "weight_scale", Tensor(np.ones(out_features, np.float32)))
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_features],
+                                              attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_linear(cls, linear: Linear) -> "QuantizedLinear":
+        """Quantize an fp ``nn.Linear``'s weight in one shot; the bias
+        (if any) is carried over in fp."""
+        q = cls(linear.in_features, linear.out_features,
+                bias_attr=False)
+        qw, scale = apply_op(quantize_absmax_raw, linear.weight, axis=0)
+        q.register_buffer("qweight", qw)
+        q.register_buffer("weight_scale", scale)
+        q.bias = linear.bias
+        return q
+
+    def dequantized_weight(self) -> Tensor:
+        """The fp32 [in, out] weight this layer computes with."""
+        return apply_op(dequantize_absmax_raw, self.qweight,
+                        self.weight_scale, axis=0)
+
+    def forward(self, x):
+        return apply_op(_qlinear_raw, x, self.qweight,
+                        self.weight_scale, self.bias)
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"weight=int8")
+
+
+def quantize_model(model: Layer, weight_dtype: str = "int8",
+                   skip: Optional[Iterable[str]] = None) -> Layer:
+    """Swap every ``nn.Linear`` under ``model`` for a
+    ``QuantizedLinear`` holding the int8-quantized weight — in place,
+    returning the same model.
+
+    ``skip``: name substrings to leave in fp (e.g. ``("lm_head",)`` to
+    keep the output projection full-precision).  Works on any
+    LLaMA/GPT-style decoder built from ``nn.Linear`` blocks; layers
+    already quantized are left alone.
+    """
+    from ..common.errors import enforce
+    enforce(weight_dtype == "int8",
+            f"unsupported weight_dtype {weight_dtype!r} (only 'int8')")
+    skip = tuple(skip or ())
+    for name, layer in list(model.named_sublayers(include_self=True)):
+        for child_name, child in list(layer._sub_layers.items()):
+            full = f"{name}.{child_name}" if name else child_name
+            if not isinstance(child, Linear):
+                continue
+            if any(s in full for s in skip):
+                continue
+            setattr(layer, child_name,
+                    QuantizedLinear.from_linear(child))
+    return model

@@ -1,0 +1,65 @@
+"""INT8 absmax quantize/dequantize primitives (weight-only serving).
+
+Reference parity: the reference's slim/quantization pass family
+(PaddleSlim's weight-only int8 for inference) re-expressed as pure
+jnp transforms: symmetric absmax scaling, int8 storage, fp compute
+after dequant.  TPU decode is HBM-bandwidth-bound, so halving the
+bytes of weights and KV pages is a direct throughput/capacity win;
+the matmuls themselves stay fp (the scale folds into the OUTPUT
+channel, so dequant costs one multiply after the MXU pass instead of
+a full-weight upcast).
+
+This module is deliberately jax-only (no Tensor/Layer imports) so the
+Pallas serving kernels can reuse the row-quantization helpers without
+an import cycle; the Tensor-level API lives in
+``paddle_tpu.quantization`` (layers.py re-exports through apply_op).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_absmax_raw", "dequantize_absmax_raw",
+           "quantize_rows_raw", "quantized_matmul_raw", "QMAX", "EPS"]
+
+QMAX = 127.0          # symmetric int8 range [-127, 127] (-128 unused)
+EPS = 1e-8            # all-zero channels quantize to scale EPS/127
+
+
+def quantize_absmax_raw(x, axis=0):
+    """Symmetric per-channel absmax quantization to int8.
+
+    ``axis`` is the REDUCTION axis (the one the scale is shared over);
+    for a paddle-layout Linear weight [in, out], axis=0 gives one scale
+    per output channel.  Returns (q int8, scale f32 with ``axis``
+    squeezed out), so ``dequantize_absmax_raw(q, scale, axis)`` is the
+    inverse up to rounding.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = jnp.maximum(absmax, EPS) / QMAX
+    q = jnp.clip(jnp.round(xf / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axis)
+
+
+def dequantize_absmax_raw(q, scale, axis=0, dtype=jnp.float32):
+    """Inverse of quantize_absmax_raw: q int8 * scale broadcast over
+    ``axis``."""
+    return (q.astype(jnp.float32)
+            * jnp.expand_dims(scale, axis)).astype(dtype)
+
+
+def quantize_rows_raw(x):
+    """Per-ROW (last-axis-shared scale) quantization for KV-cache
+    tokens: x [..., D] -> (q int8 [..., D], scale f32 [...]).  One
+    scale per token row — the granularity the paged pools store
+    alongside each page."""
+    return quantize_absmax_raw(x, axis=-1)
+
+
+def quantized_matmul_raw(x, qw, scale):
+    """x @ dequant(qw) with the scale folded into the output channel:
+    (x @ qw) * scale.  qw [in, out] int8, scale [out] f32 — exact for
+    per-output-channel scales, and the MXU pass runs on the int8
+    weight upcast to x.dtype instead of a materialized fp weight."""
+    y = jnp.matmul(x, qw.astype(x.dtype))
+    return y * scale.astype(y.dtype)

@@ -45,7 +45,10 @@ def _bilinear_gather(img, y, x):
     border semantics: points past [-1, dim] contribute 0, edge points
     clamp.  img leading dims broadcast against the sample dims."""
     H, W = img.shape[-2], img.shape[-1]
-    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    # reference roi_align border semantics: only samples STRICTLY past
+    # [-1, dim] are zeroed; y == -1 / y == H clamp to the edge value
+    # (boxes flush with the border under aligned=True) — ADVICE r5 #5
+    valid = (y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W)
     y = jnp.clip(y, 0.0, H - 1)
     x = jnp.clip(x, 0.0, W - 1)
     y0 = jnp.floor(y).astype(jnp.int32)
