@@ -823,6 +823,83 @@ def bench_serving_quant():
                   "decode_compiles": LLMEngine.decode_compiles()}}
 
 
+def bench_serving_metrics():
+    """Observability-overhead row (ISSUE 2): decode tokens/sec through
+    the SAME engine workload with the metrics runtime off vs on.  The
+    instrumentation records O(1) host floats per decode WINDOW (TPOT is
+    a weighted histogram observe, not per-token), so the acceptance bar
+    is <=2% throughput overhead with metrics enabled."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 256, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 4, 96, 8, 128, 4
+        prompts = [8, 5, 12, 9]
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if not on_tpu:
+        dtype = np.float32
+
+    def run(enable):
+        rng = np.random.default_rng(0)
+        eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                        page_size=page, dtype=dtype,
+                        steps_per_sync=sync, enable_metrics=enable)
+        for i, plen in enumerate(prompts):
+            eng.add_request(
+                f"w{i}", rng.integers(1, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=new)
+        eng.step()                     # warmup: compiles the window
+        produced0 = sum(len(r.out) for r in eng.requests.values())
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.out)
+                    for r in eng.requests.values()) - produced0
+        return total / dt, eng
+
+    run(False)                         # shared compile + cache warmup
+    # interleave the arms so host clock drift hits both equally; the
+    # per-arm max is the usual best-of-N noise floor estimator (the
+    # 1-core CI box jitters ~2-3% run to run, well above the true
+    # instrumentation cost)
+    off, on = [], []
+    eng_on = None
+    for _ in range(5):
+        off.append(run(False)[0])
+        rate, eng_on = run(True)
+        on.append(rate)
+    best_off, best_on = max(off), max(on)
+    overhead = (best_off - best_on) / best_off
+    snap = eng_on.metrics_snapshot()
+    return {"metric": "llama_engine_metrics_overhead_pct",
+            "unit": "percent", "value": round(overhead * 100, 2),
+            "extra": {"device_kind": kind,
+                      "tokens_per_sec_metrics_off": round(best_off, 1),
+                      "tokens_per_sec_metrics_on": round(best_on, 1),
+                      "ttft_p_mean_ms": round(
+                          snap["ttft_seconds"]["mean"] * 1e3, 2),
+                      "tpot_mean_us": round(
+                          snap["tpot_seconds"]["mean"] * 1e6, 1),
+                      "prefill_compiles": snap["prefill_compiles"],
+                      "decode_compiles": snap["decode_compiles"],
+                      "budget": "overhead <= 2%"}}
+
+
 def jnp_bf16():
     import jax.numpy as jnp
     return jnp.bfloat16
@@ -935,6 +1012,7 @@ def main():
                ("bench_paged_kernel", bench_paged_kernel),
                ("bench_engine", bench_engine),
                ("bench_serving_quant", bench_serving_quant),
+               ("bench_serving_metrics", bench_serving_metrics),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

@@ -33,6 +33,7 @@ from . import incubate
 from .framework import io as _framework_io
 from .framework.io import load, save
 from . import metric
+from . import observability
 from . import profiler
 from . import visualdl
 from . import hapi

@@ -67,7 +67,13 @@ class ShardedTrainStep(CompiledTrainStep):
         self._key, sub = jax.random.split(self._key)
         lr = self.optimizer.get_lr()
         batch = self.plan.shard_batch(_to_arrays(batch))
+        # same StepTimer contract as the parent: fence on the sharded
+        # outputs so multi-chip async dispatch can't flatter step time
+        if self._timer is not None:
+            self._timer.start()
         self.state, loss = self._step_fn(self.state, batch, sub, lr)
+        if self._timer is not None:
+            self._timer.stop(fence=(self.state, loss))
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()

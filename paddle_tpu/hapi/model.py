@@ -233,7 +233,7 @@ class Model:
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
         enforce(loader is not None, "fit needs train_data")
-        self._ensure_train_step()
+        step = self._ensure_train_step()
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, batch_size=batch_size,
@@ -242,6 +242,16 @@ class Model:
                                 save_freq=save_freq,
                                 metrics=[n for m in self._metrics
                                          for n in _as_list(m.name())])
+        # per-step telemetry: wall time (block_until_ready fenced),
+        # tokens/s, MFU — into the metrics registry, and mirrored to
+        # the VisualDL callback's writer when one is configured
+        from .callbacks import VisualDL
+        from ..observability import StepTimer
+        vdl = next((c for c in cbks.callbacks
+                    if isinstance(c, VisualDL)), None)
+        timer = StepTimer(prefix="train",
+                          writer=vdl._w() if vdl is not None else None)
+        step.attach_timer(timer)
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
@@ -255,7 +265,23 @@ class Model:
             for step_i, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step_i)
                 ins, labs = self._split_batch(batch)
+                if ins:
+                    # tokens/s convention: elements of the first input
+                    # ([B, S] ids for an LM = real tokens); np.shape
+                    # reads .shape — no host copy of the batch
+                    timer.tokens_per_step = int(
+                        np.prod(np.shape(ins[0]))) or None
                 logs = {"loss": self.train_batch(ins, labs)[0]}
+                if timer.flops_per_step is None and \
+                        timer.peak_flops is not None:
+                    # first step compiled the program: one AOT lowering
+                    # prices the step for the MFU gauge (skipped when
+                    # the host has no known peak — CPU runs)
+                    timer.flops_per_step = step.step_flops(
+                        {"inputs": tuple(_as_list(ins)),
+                         "labels": tuple(_as_list(labs))})
+                    if timer.flops_per_step is None:
+                        timer.peak_flops = None   # don't retry per step
                 if self._metrics:
                     preds = self._last_train_preds
                     self._last_train_preds = None  # consume: don't pin
@@ -279,6 +305,9 @@ class Model:
                               verbose=verbose, num_workers=num_workers,
                               callbacks=cbks)
         cbks.on_train_end(logs)
+        # the VisualDL callback closed its writer above — detach the
+        # timer so later direct train_batch calls can't write into it
+        step.attach_timer(None)
         self._in_fit = False
         self._last_train_preds = None
         return self

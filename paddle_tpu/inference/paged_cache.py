@@ -20,13 +20,17 @@ fixed HBM budget.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..common.errors import enforce
+from ..observability import get_registry
 
 __all__ = ["PagedKVCache"]
+
+_CACHE_IDS = itertools.count()
 
 
 class PagedKVCache:
@@ -62,6 +66,36 @@ class PagedKVCache:
         self._table = np.zeros((max_seqs, self.max_pages_per_seq),
                                np.int32)
         self._used = [False] * max_seqs
+        # page-pressure telemetry (host-side counters — negligible next
+        # to the device work these methods bracket); one label set per
+        # cache instance so concurrent engines don't blur each other
+        reg = get_registry()
+        self.cache_id = str(next(_CACHE_IDS))
+        lbl = ("cache",)
+        self._m_alloc = reg.counter(
+            "kv_cache_pages_allocated_total",
+            "KV pages taken from the free list.", lbl).labels(
+                self.cache_id)
+        self._m_release = reg.counter(
+            "kv_cache_pages_released_total",
+            "KV pages returned to the free list.", lbl).labels(
+                self.cache_id)
+        self._m_oom = reg.counter(
+            "kv_cache_oom_total",
+            "Allocation/extension failures: not enough free pages.",
+            lbl).labels(self.cache_id)
+        self._m_util = reg.gauge(
+            "kv_cache_page_utilization",
+            "Fraction of usable pages in use (page 0 is the reserved "
+            "pad page).", lbl).labels(self.cache_id)
+
+    def page_utilization(self) -> float:
+        """In-use fraction of the usable pool (excludes pad page 0)."""
+        usable = self.n_pages - 1
+        return 1.0 - len(self._free) / usable if usable else 0.0
+
+    def _track_pages(self):
+        self._m_util.set(self.page_utilization())
 
     # -- host-side accounting --------------------------------------------------
     def allocate(self, n_tokens: int) -> int:
@@ -71,15 +105,19 @@ class PagedKVCache:
         enforce(free_slots, "paged cache: all sequence slots in use")
         slot = free_slots[0]
         need = (n_tokens + self.page_size - 1) // self.page_size
+        if len(self._free) < need:
+            self._m_oom.inc()
         enforce(len(self._free) >= need,
                 f"paged cache OOM: need {need} pages, "
                 f"{len(self._free)} free")
         pages = [self._free.pop() for _ in range(need)]
+        self._m_alloc.inc(need)
         self._used[slot] = True
         self._pages[slot] = pages
         self._lens[slot] = 0
         self._table[slot, :] = 0
         self._table[slot, :need] = pages
+        self._track_pages()
         return slot
 
     def extend(self, slot: int, n_tokens: int = 1):
@@ -87,18 +125,25 @@ class PagedKVCache:
         have = len(self._pages[slot]) * self.page_size
         need_total = int(self._lens[slot]) + n_tokens
         while have < need_total:
+            if not self._free:
+                self._m_oom.inc()
             enforce(self._free, "paged cache OOM on extend")
             pg = self._free.pop()
+            self._m_alloc.inc()
             idx = len(self._pages[slot])
             self._pages[slot].append(pg)
             self._table[slot, idx] = pg
             have += self.page_size
+        self._track_pages()
 
     def release(self, slot: int):
-        self._free.extend(reversed(self._pages.pop(slot)))
+        pages = self._pages.pop(slot)
+        self._free.extend(reversed(pages))
+        self._m_release.inc(len(pages))
         self._used[slot] = False
         self._lens[slot] = 0
         self._table[slot, :] = 0
+        self._track_pages()
 
     def set_len(self, slot: int, n: int):
         """Host-side length after an in-graph prefill wrote the pages
@@ -131,6 +176,15 @@ class PagedKVCache:
         else:
             per_row = head_dim * self.k_pages.dtype.itemsize
         return 2 * self.num_layers * kvh * per_row
+
+    def metrics_snapshot(self) -> dict:
+        """This cache's page-pressure counters (host view; the same
+        series are in the global registry under label cache=<id>)."""
+        return {"pages_allocated": int(self._m_alloc.value),
+                "pages_released": int(self._m_release.value),
+                "oom_events": int(self._m_oom.value),
+                "free_pages": self.free_page_count(),
+                "page_utilization": self.page_utilization()}
 
     # -- device-side ops -------------------------------------------------------
     def _norm_layers(self, k, v, tokens_axis: int):

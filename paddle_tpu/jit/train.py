@@ -84,6 +84,41 @@ class CompiledTrainStep:
         self._step_fn = None
         self._donate = donate
         self._has_aux = has_aux
+        self._timer = None
+        self._flops_cache = None
+
+    # -- telemetry -----------------------------------------------------------
+    def attach_timer(self, timer):
+        """Attach an observability.StepTimer: every __call__ is then
+        timed with a block_until_ready fence on the step's outputs
+        (honest device-inclusive step time despite async dispatch)."""
+        self._timer = timer
+
+    def step_flops(self, batch) -> Optional[float]:
+        """Estimated FLOPs of one fused step from XLA's cost model
+        (for MFU).  Cached after the first call; returns None when the
+        backend's cost analysis is unavailable.  Note: this AOT-lowers
+        the step once more (the dispatch-path executable is cached
+        separately), so callers should ask once, not per step."""
+        if self._flops_cache is not None:
+            return self._flops_cache if self._flops_cache > 0 else None
+        if self._step_fn is None:
+            self._build()
+        try:
+            lowered = self._step_fn.lower(
+                self.state, _to_arrays(batch), jax.random.key(0),
+                self.optimizer.get_lr())
+            try:
+                cost = lowered.cost_analysis()
+            except Exception:
+                cost = lowered.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", -1.0))
+        except Exception:
+            flops = -1.0
+        self._flops_cache = flops if flops > 0 else -1.0
+        return flops if flops > 0 else None
 
     def _make_step(self):
         """The raw (un-jitted) fused step fn: fwd+bwd+clip+update."""
@@ -122,8 +157,12 @@ class CompiledTrainStep:
             self._build()
         self._key, sub = jax.random.split(self._key)
         lr = self.optimizer.get_lr()
+        if self._timer is not None:
+            self._timer.start()
         self.state, out = self._step_fn(self.state, _to_arrays(batch), sub,
                                         lr)
+        if self._timer is not None:
+            self._timer.stop(fence=(self.state, out))
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()

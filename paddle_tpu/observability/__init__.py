@@ -1,0 +1,29 @@
+"""paddle_tpu.observability — serving & training telemetry.
+
+Three pieces, all stdlib-only:
+
+- :mod:`~paddle_tpu.observability.metrics` — Counter/Gauge/Histogram
+  + the process-global ``MetricRegistry`` everything reports into.
+- :mod:`~paddle_tpu.observability.exposition` — Prometheus text
+  scrape endpoint (``start_metrics_server``) and crash-safe JSONL
+  snapshots (``JsonlSnapshotWriter``).
+- :mod:`~paddle_tpu.observability.steptimer` — ``StepTimer``:
+  fenced per-step wall time, tokens/s, and cost_analysis-based MFU
+  for the training loop (wired through ``hapi.Model.fit`` and
+  ``jit.train.CompiledTrainStep.attach_timer``).
+
+Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
+gauges, compile-count gauges) lives with the instrumented code in
+``inference/engine.py`` / ``inference/paged_cache.py`` and surfaces
+through ``LLMEngine.metrics_snapshot()`` plus the registry exposition.
+"""
+from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
+                      DEFAULT_BUCKETS, get_registry)
+from .exposition import (JsonlSnapshotWriter, MetricsServer,
+                         start_metrics_server)
+from .steptimer import StepTimer, device_peak_flops
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "DEFAULT_BUCKETS", "get_registry", "JsonlSnapshotWriter",
+           "MetricsServer", "start_metrics_server", "StepTimer",
+           "device_peak_flops"]

@@ -17,11 +17,18 @@ from __future__ import annotations
 import json
 import os
 import time
+from collections import deque
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+# Completed RecordEvent host ranges (name, t0, t1) — bounded ring so
+# always-on instrumentation (e.g. the serving engine's prefill/decode
+# spans) can't grow memory; export_chrome_tracing drains the ranges
+# that overlap the profiler session into the chrome-trace JSON.
+_HOST_EVENTS: deque = deque(maxlen=100_000)
 
 
 class ProfilerState(Enum):
@@ -70,12 +77,19 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
     def handler(prof: "Profiler"):
         prof._export_dir = dir_name
         os.makedirs(dir_name, exist_ok=True)
-        steps = [{"name": f"step {i}", "ph": "X", "pid": 0, "tid": 0,
-                  "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
-                 for i, (t0, t1) in enumerate(prof._step_times)]
+        events = [{"name": f"step {i}", "ph": "X", "pid": 0, "tid": 0,
+                   "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
+                  for i, (t0, t1) in enumerate(prof._step_times)]
+        # RecordEvent host ranges from this session (engine prefill/
+        # decode spans etc.) land on their own track next to the steps
+        begin = prof._session_begin or 0.0
+        events.extend(
+            {"name": name, "ph": "X", "pid": 0, "tid": 1,
+             "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
+            for name, t0, t1 in list(_HOST_EVENTS) if t0 >= begin)
         with open(os.path.join(dir_name, "steps.chrome_trace.json"),
                   "w") as f:
-            json.dump({"traceEvents": steps}, f)
+            json.dump({"traceEvents": events}, f)
 
     # the Profiler reads this to keep the XPlane capture and the step
     # table in ONE directory when the user only passes on_trace_ready
@@ -90,16 +104,22 @@ class RecordEvent:
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
+        self._t0 = None
 
     def begin(self):
         import jax
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        self._t0 = time.perf_counter()
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._t0 is not None:
+            _HOST_EVENTS.append((self.name, self._t0,
+                                 time.perf_counter()))
+            self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -149,15 +169,26 @@ class Profiler:
         self._tracing = False
         self._step_times = []
         self._step_begin = None
+        self._session_begin = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         self._step_num = 0
         self._apply_state(self._schedule(0))
         self._step_begin = time.perf_counter()
+        self._session_begin = self._step_begin
         return self
 
     def stop(self):
+        # close out the in-flight step interval: work done between the
+        # last step() (or start()) and stop() is a step too — without
+        # this a start()...stop() session with no step() calls records
+        # nothing and summary() claims "no steps recorded"
+        if self._step_begin is not None:
+            now = time.perf_counter()
+            if now > self._step_begin:
+                self._step_times.append((self._step_begin, now))
+            self._step_begin = None
         self._stop_trace()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)

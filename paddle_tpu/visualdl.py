@@ -24,6 +24,7 @@ class LogWriter:
         os.makedirs(logdir, exist_ok=True)
         self._f = open(os.path.join(logdir, "scalars.jsonl"), "a")
         self._tb = None
+        self._auto_step = 0      # monotonic default for step=None events
         try:  # optional TensorBoard mirror
             from tensorboard.summary.writer.event_file_writer import \
                 EventFileWriter
@@ -41,11 +42,21 @@ class LogWriter:
         rec = {"tag": tag, "value": float(value), "step": step, "time": wt}
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
+        # the TB mirror needs SOME int step: pass the real step through
+        # (`step or 0` squashed every step=None event onto step 0,
+        # which TensorBoard renders as one overwritten point) and only
+        # default — to a per-writer monotonic counter — when None
+        if step is None:
+            tb_step = self._auto_step
+            self._auto_step += 1
+        else:
+            tb_step = int(step)
+            self._auto_step = max(self._auto_step, tb_step + 1)
         if self._tb is not None:
             s = self._Summary(
                 value=[self._Summary.Value(tag=tag,
                                            simple_value=float(value))])
-            self._tb.add_event(self._Event(summary=s, step=step or 0,
+            self._tb.add_event(self._Event(summary=s, step=tb_step,
                                            wall_time=wt))
 
     def flush(self):

@@ -3,6 +3,13 @@ topology-building tests can't leak meshes into each other."""
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (trace capture, big compiles) — excluded "
+        "from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(autouse=True)
 def _reset_fleet_state():
     yield

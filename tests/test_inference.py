@@ -13,6 +13,17 @@ from paddle_tpu.inference import (Config, PagedKVCache, Predictor,
 from paddle_tpu.ops.pallas.paged_attention import (
     paged_attention_raw, paged_attention_reference, paged_write)
 
+# capability probes: jax 0.4.x lacks the Pallas interpret-mode context
+# manager and the jax.export module attribute — skip (not fail) the
+# tests that need them so tier-1 is green on environment, red on code
+needs_tpu_interpret = pytest.mark.skipif(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason="this jax has no pltpu.force_tpu_interpret_mode "
+           "(kernel-vs-reference parity runs on TPU-capable jax only)")
+needs_jax_export = pytest.mark.skipif(
+    not hasattr(jax, "export"),
+    reason="this jax has no jax.export (jit.save interchange format)")
+
 
 def _rand_pages(rng, kvh=2, n_pages=16, page=8, d=16):
     k = rng.normal(size=(kvh, n_pages, page, d)).astype(np.float32)
@@ -70,6 +81,7 @@ class TestPagedAttentionKernel:
         np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5,
                                    atol=2e-5)
 
+    @needs_tpu_interpret
     def test_kernel_matches_reference_ragged(self):
         args = self._case([5, 16, 23, 1])
         with pltpu.force_tpu_interpret_mode():
@@ -78,6 +90,7 @@ class TestPagedAttentionKernel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @needs_tpu_interpret
     def test_kernel_full_pages_and_single_token(self):
         args = self._case([32, 8], maxp=4)
         with pltpu.force_tpu_interpret_mode():
@@ -86,6 +99,7 @@ class TestPagedAttentionKernel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @needs_tpu_interpret
     def test_fused_append_attend_matches_reference(self):
         """One kernel appends K/V and attends incl. the new token; the
         returned pools equal the scatter-written ones exactly."""
@@ -187,6 +201,7 @@ class TestPagedKVCache:
 
 
 class TestPredictor:
+    @needs_jax_export
     def test_save_then_serve(self, tmp_path):
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))

@@ -151,6 +151,10 @@ class TestToStatic:
 
 
 class TestJitSaveLoad:
+    @pytest.mark.skipif(
+        not hasattr(__import__("jax"), "export"),
+        reason="this jax has no jax.export (jit.save interchange "
+               "format)")
     def test_save_load_inference(self, tmp_path):
         model = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 3))
         model.eval()

@@ -1,13 +1,186 @@
-"""Profiler / nan-check / metric / LogWriter tests (SURVEY.md §5 aux
+"""Observability: metrics runtime (Counter/Gauge/Histogram/registry),
+Prometheus + JSONL exposition, engine serving metrics, StepTimer,
+profiler / nan-check / metric / LogWriter (SURVEY.md §5 aux
 subsystems: tracing, sanitizer, metrics/logging)."""
 import json
 import os
+import threading
+import time
 
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.common.flags import set_flags
+from paddle_tpu.observability import (Counter, Gauge, Histogram,
+                                      JsonlSnapshotWriter,
+                                      MetricRegistry, StepTimer,
+                                      get_registry,
+                                      start_metrics_server)
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _golden_registry() -> MetricRegistry:
+    """The fixed registry the Prometheus golden file was rendered
+    from — any format drift fails the golden test."""
+    r = MetricRegistry()
+    c = r.counter("llm_engine_generated_tokens_total",
+                  "Tokens returned to requests.", labelnames=("engine",))
+    c.labels("0").inc(7)
+    c.labels("1").inc(3)
+    g = r.gauge("kv_cache_page_utilization",
+                "Fraction of usable pages in use.", labelnames=("cache",))
+    g.labels("0").set(0.25)
+    h = r.histogram("llm_engine_ttft_seconds", "Time to first token.",
+                    buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5, n=3)
+    h.observe(2.0)
+    return r
+
+
+class TestMetricsRuntime:
+    def test_counter_inc_and_labels(self):
+        r = MetricRegistry()
+        c = r.counter("reqs_total", "x", labelnames=("engine",))
+        c.labels("0").inc()
+        c.labels("0").inc(2)
+        c.labels(engine="1").inc()
+        assert c.labels("0").value == 3
+        assert c.value == 4            # family total across label sets
+        with pytest.raises(ValueError):
+            c.labels("0").inc(-1)      # counters only go up
+
+    def test_gauge_set_inc_dec(self):
+        r = MetricRegistry()
+        g = r.gauge("depth")
+        g.set(5)
+        g.inc()
+        g.dec(3)
+        assert g.value == 3
+
+    def test_histogram_cumulative_buckets_and_weighted_observe(self):
+        r = MetricRegistry()
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.1)                 # le= boundary lands IN the bucket
+        h.observe(0.5, n=3)
+        h.observe(2.0)
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert snap["buckets"] == {"0.1": 1, "1": 4, "+Inf": 5}
+        assert snap["sum"] == pytest.approx(0.1 + 1.5 + 2.0)
+        assert h.mean == pytest.approx(snap["sum"] / 5)
+
+    def test_registry_get_or_create_and_kind_guard(self):
+        r = MetricRegistry()
+        c1 = r.counter("a_total", "help")
+        assert r.counter("a_total") is c1
+        with pytest.raises(ValueError):
+            r.gauge("a_total")         # kind mismatch
+        with pytest.raises(ValueError):
+            r.counter("a_total", labelnames=("x",))  # schema mismatch
+
+    def test_thread_safety_under_contention(self):
+        r = MetricRegistry()
+        c = r.counter("hits_total")
+        h = r.histogram("obs", buckets=(1.0,))
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.5)
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.value == 4000
+        assert h.count == 4000
+
+
+class TestExposition:
+    def test_prometheus_text_matches_golden_file(self):
+        golden = open(os.path.join(GOLDEN_DIR,
+                                   "prometheus_exposition.txt")).read()
+        assert _golden_registry().expose_text() == golden
+
+    def test_jsonl_snapshot_writer(self, tmp_path):
+        r = _golden_registry()
+        with JsonlSnapshotWriter(str(tmp_path / "m"), registry=r) as w:
+            w.write(walltime=1.0)
+            r.get("llm_engine_generated_tokens_total").labels("0").inc(5)
+            w.write(walltime=2.0)
+        lines = [json.loads(l) for l in open(w.path)]
+        assert [l["time"] for l in lines] == [1.0, 2.0]
+        vals = [l["metrics"]["llm_engine_generated_tokens_total"]
+                ["values"]["engine=0"] for l in lines]
+        assert vals == [7.0, 12.0]
+
+    def test_http_scrape_endpoint(self):
+        import urllib.request
+        r = _golden_registry()
+        srv = start_metrics_server(port=0, registry=r)
+        try:
+            resp = urllib.request.urlopen(srv.url, timeout=10)
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert body == r.expose_text()
+        finally:
+            srv.shutdown()
+
+
+class TestStepTimer:
+    def test_records_fenced_step_time_and_rates(self):
+        import jax.numpy as jnp
+        r = MetricRegistry()
+        t = StepTimer(registry=r, prefix="unit", tokens_per_step=100,
+                      flops_per_step=1e6, peak_flops=1e9)
+        t.start()
+        x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+        dt = t.stop(fence=x)
+        assert dt is not None and dt > 0
+        s = t.summary()
+        assert s["steps"] == 1
+        assert s["tokens_per_sec"] == pytest.approx(100 / dt)
+        assert s["mfu"] == pytest.approx(1e6 / (dt * 1e9))
+        assert r.get("unit_step_seconds").count == 1
+
+    def test_stop_without_start_is_noop(self):
+        t = StepTimer(registry=MetricRegistry(), prefix="unit2")
+        assert t.stop() is None
+
+    def test_step_flops_from_cost_analysis(self):
+        """The MFU numerator: CompiledTrainStep prices one fused step
+        via XLA cost_analysis (cached after the first ask)."""
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit.train import CompiledTrainStep
+        paddle.seed(0)
+        model = nn.Linear(8, 4)
+        step = CompiledTrainStep(
+            model, lambda m, b: paddle.ops.mean(m(b["x"]) ** 2),
+            optimizer.SGD(learning_rate=0.1))
+        batch = {"x": np.ones((2, 8), "float32")}
+        flops = step.step_flops(batch)
+        assert flops is None or flops > 0
+        if flops is not None:    # fwd+bwd of an 8x4 matmul at batch 2
+            assert flops > 2 * 8 * 4 * 2
+        assert step.step_flops(batch) == flops     # cached
+
+    def test_fit_drives_timer_into_registry(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        reg = get_registry()
+        before = reg.get("train_steps_total")
+        before = before.value if before is not None else 0
+        paddle.seed(0)
+        m = Model(nn.Linear(4, 2))
+        m.prepare(optimizer.SGD(learning_rate=0.1),
+                  loss=lambda p, y: paddle.ops.mean((p - y) ** 2))
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype("float32")
+        y = np.zeros((8, 2), "float32")
+        m.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0)
+        assert reg.get("train_steps_total").value == before + 2
+        assert reg.get("train_tokens_per_sec").value > 0
 
 
 class TestProfiler:
@@ -20,6 +193,7 @@ class TestProfiler:
                           ProfilerState.RECORD_AND_RETURN,
                           ProfilerState.CLOSED]
 
+    @pytest.mark.slow  # captures a real XPlane trace — not tier-1 work
     def test_smoke_produces_trace_dir(self, tmp_path):
         import jax
         import jax.numpy as jnp
@@ -125,3 +299,206 @@ class TestLogWriter:
                  open(tmp_path / "vdl" / "scalars.jsonl")]
         assert [l["value"] for l in lines] == [1.5, 1.2]
         assert [l["step"] for l in lines] == [0, 1]
+
+    def test_tb_mirror_does_not_conflate_none_step_with_zero(self,
+                                                             tmp_path):
+        """`step or 0` squashed every step=None event onto TB step 0;
+        None must default to a monotonic counter, real steps pass
+        through untouched."""
+        from paddle_tpu.visualdl import LogWriter
+
+        class _Event:
+            def __init__(self, summary=None, step=None, wall_time=None):
+                self.step = step
+
+        class _Summary:
+            class Value:
+                def __init__(self, tag=None, simple_value=None):
+                    pass
+
+            def __init__(self, value=None):
+                pass
+
+        class _TB:
+            def __init__(self):
+                self.events = []
+
+            def add_event(self, e):
+                self.events.append(e)
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        with LogWriter(logdir=str(tmp_path / "vdl")) as w:
+            if w._tb is not None:      # a real tensorboard install
+                w._tb.close()
+            w._tb = _TB()
+            w._Summary = _Summary
+            w._Event = _Event
+            w.add_scalar("a", 1.0)             # None -> auto 0
+            w.add_scalar("a", 2.0)             # None -> auto 1
+            w.add_scalar("a", 3.0, step=7)     # real step passes through
+            w.add_scalar("a", 4.0)             # continues after 7
+            assert [e.step for e in w._tb.events] == [0, 1, 7, 8]
+            # JSONL keeps the caller's step verbatim (None stays null)
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "vdl" / "scalars.jsonl")]
+        assert [l["step"] for l in lines] == [None, None, 7, None]
+
+
+class TestProfilerHostSpans:
+    def test_stop_closes_in_flight_step_interval(self):
+        """start() ... stop() with no step() is still one step — not
+        'no steps recorded'."""
+        from paddle_tpu.profiler import Profiler
+        p = Profiler(timer_only=True)
+        p.start()
+        time.sleep(0.005)
+        p.stop()
+        assert len(p._step_times) == 1
+        assert "avg=" in p.summary()
+
+    def test_record_event_spans_land_in_chrome_trace(self, tmp_path):
+        """RecordEvent host ranges (the engine's prefill/decode spans)
+        show up in the steps.chrome_trace.json that
+        export_chrome_tracing writes — timer_only, so no XPlane
+        capture cost in tier-1."""
+        from paddle_tpu.profiler import (Profiler, RecordEvent,
+                                         export_chrome_tracing)
+        d = str(tmp_path / "prof")
+        p = Profiler(timer_only=True,
+                     on_trace_ready=export_chrome_tracing(d))
+        p.start()
+        with RecordEvent("unit_test_span"):
+            time.sleep(0.002)
+        p.step()
+        p.stop()
+        trace = json.load(open(os.path.join(d,
+                                            "steps.chrome_trace.json")))
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "unit_test_span" in names
+        assert any(n.startswith("step ") for n in names)
+        span = next(e for e in trace["traceEvents"]
+                    if e["name"] == "unit_test_span")
+        assert span["dur"] >= 1000     # >= 1ms in trace microseconds
+
+
+class TestVisualDLCallback:
+    def test_writes_train_and_eval_scalars_and_closes(self, tmp_path):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import VisualDL
+        paddle.seed(0)
+        m = Model(nn.Linear(4, 2))
+        m.prepare(optimizer.SGD(learning_rate=0.1),
+                  loss=lambda p, y: paddle.ops.mean((p - y) ** 2))
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype("float32")
+        y = np.zeros((8, 2), "float32")
+        cb = VisualDL(log_dir=str(tmp_path / "vdl"))
+        m.fit(list(zip(x, y)), eval_data=list(zip(x, y)), batch_size=4,
+              epochs=1, verbose=0, callbacks=[cb])
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "vdl" / "scalars.jsonl")]
+        tags = {l["tag"] for l in lines}
+        assert "train/loss" in tags
+        assert "eval/loss" in tags
+        # the StepTimer mirrors its series into the same writer
+        assert "train/step_time_ms" in tags
+        # train end closed the writer
+        assert cb._writer._f.closed
+        # train scalars carry increasing steps
+        steps = [l["step"] for l in lines if l["tag"] == "train/loss"]
+        assert steps == sorted(steps) and len(steps) == 2
+
+
+class TestEngineMetrics:
+    @pytest.fixture(scope="class")
+    def served(self):
+        """One tiny engine run shared by the assertions below: two
+        ragged requests admitted, decoded to completion."""
+        from paddle_tpu.inference.engine import LLMEngine
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny_config())
+        model.eval()
+        eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+        eng.add_request("a", [5, 9, 2, 14], max_new_tokens=6)
+        eng.add_request("b", [3, 3, 7], max_new_tokens=4)
+        eng.step()
+        # compile counts after the first admissions + decode window:
+        # the REST of the run (mixed lengths, requests retiring) must
+        # not add programs.  (Absolute ==1 only holds per fresh
+        # process — the jit caches are shared with other test files.)
+        c_prefill = LLMEngine.prefill_compiles()
+        c_decode = LLMEngine.decode_compiles()
+        while eng.has_work():
+            eng.step()
+        return eng, c_prefill, c_decode
+
+    def test_snapshot_latency_and_token_series(self, served):
+        eng, _, _ = served
+        snap = eng.metrics_snapshot()
+        assert snap["ttft_seconds"]["count"] == 2
+        assert snap["ttft_seconds"]["sum"] > 0
+        # 6 + 4 tokens produced (prefill token included), 7 prompt
+        assert snap["generated_tokens"] == 10
+        assert snap["prompt_tokens"] == 7
+        assert snap["requests"] == 2
+        # tpot count advances by window positions; both requests ran
+        # to completion through single-token windows
+        assert snap["tpot_seconds"]["count"] >= 5
+        assert snap["queue_depth"] == 0
+        assert 0 < snap["batch_occupancy"] <= 1
+
+    def test_snapshot_kv_and_compile_invariants(self, served):
+        eng, c_prefill, c_decode = served
+        snap = eng.metrics_snapshot()
+        # mixed prompt lengths and the whole decode (requests
+        # retiring, batch shrinking) added ZERO compiled programs
+        assert snap["prefill_compiles"] == c_prefill >= 1
+        assert snap["decode_compiles"] == c_decode >= 1
+        kv = snap["kv_cache"]
+        assert kv["pages_allocated"] >= 2
+        assert kv["pages_allocated"] == kv["pages_released"]
+        assert kv["oom_events"] == 0
+        assert snap["kv_page_utilization"] == 0.0   # all released
+
+    def test_registry_exposes_engine_series(self, served):
+        eng, _, _ = served
+        text = get_registry().expose_text()
+        eid = eng.engine_id
+        assert f'llm_engine_ttft_seconds_count{{engine="{eid}"}} 2' \
+            in text
+        assert f'llm_engine_generated_tokens_total{{engine="{eid}"}} ' \
+               f'10' in text
+        assert "# TYPE llm_engine_tpot_seconds histogram" in text
+        assert "llm_engine_prefill_compiles" in text
+
+    def test_enable_metrics_false_still_snapshots_core(self, served):
+        from paddle_tpu.inference.engine import LLMEngine
+        eng, _, _ = served
+        quiet = LLMEngine(eng.model, max_seqs=2, max_len=64,
+                          page_size=8, enable_metrics=False)
+        quiet.add_request("q", [5, 9, 2], max_new_tokens=2)
+        while quiet.has_work():
+            quiet.step()
+        snap = quiet.metrics_snapshot()
+        assert "ttft_seconds" not in snap       # registry series off
+        assert snap["prefill_compiles"] >= 1    # invariants still on
+        assert "page_utilization" in snap["kv_cache"]
+
+    def test_cache_oom_counter(self):
+        from paddle_tpu.inference import PagedKVCache
+        c = PagedKVCache(n_pages=4, page_size=4, n_kv_heads=1,
+                         head_dim=8, max_seqs=2, max_len=16)
+        c.allocate(8)                          # 2 of 3 usable pages
+        with pytest.raises(ValueError):
+            c.allocate(8)                      # needs 2, 1 free
+        snap = c.metrics_snapshot()
+        assert snap["oom_events"] == 1
+        assert snap["pages_allocated"] == 2
+        assert snap["page_utilization"] == pytest.approx(2 / 3)
