@@ -900,6 +900,85 @@ def bench_serving_metrics():
                       "budget": "overhead <= 2%"}}
 
 
+def bench_serving_prefix():
+    """Automatic-prefix-caching row (ISSUE 3): N requests sharing a
+    long system prompt, admitted through the SAME engine workload with
+    prefix caching off vs on (same process, so ``vs_baseline`` is an
+    honest in-process ratio).  Reports the shared-prefix TTFT (the
+    cached requests skip the shared chunks' prefill entirely) and the
+    page capacity the sharing buys: pages in use after admission with
+    sharing on vs off at the same request mix."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 32, 128, 2048, 8
+        sys_len, sfx_len = 512, 17          # 4 shared pages per prompt
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 8, 8, 8, 128, 2
+        sys_len, sfx_len = 16, 3            # 2 shared pages per prompt
+        dtype = np.float32
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+    suffixes = [rng.integers(1, cfg.vocab_size, sfx_len).tolist()
+                for _ in range(batch)]
+
+    def run(enable):
+        eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                        page_size=page, dtype=dtype,
+                        steps_per_sync=sync,
+                        enable_prefix_caching=enable)
+        ttfts = []
+        for i, sfx in enumerate(suffixes):
+            t0 = time.perf_counter()
+            eng.add_request(f"p{i}", sys_prompt + sfx,
+                            max_new_tokens=new)
+            ttfts.append(time.perf_counter() - t0)
+        pages_used = (eng.cache.n_pages - 1) - eng.cache.free_page_count()
+        while eng.has_work():
+            eng.step()
+        # request 0 is the compulsory miss that populates the cache;
+        # the shared-prefix TTFT is the mean over the rest
+        return float(np.mean(ttfts[1:])), pages_used, eng
+
+    run(False)                        # warmup: compiles prefill+decode
+    ttft_off, pages_off, _ = run(False)
+    ttft_on, pages_on, eng = run(True)
+    st = eng.prefix_stats
+    return {
+        "metric": "serving_prefix_cache_ttft_seconds",
+        "value": round(ttft_on, 5),
+        "unit": "seconds",
+        "vs_baseline": round(ttft_on / ttft_off, 3),
+        "extra": {"device_kind": kind, "requests": batch,
+                  "sys_prompt_tokens": sys_len,
+                  "suffix_tokens": sfx_len, "page_size": page,
+                  "ttft_seconds_sharing_off": round(ttft_off, 5),
+                  "ttft_seconds_sharing_on": round(ttft_on, 5),
+                  "ttft_speedup": round(ttft_off / ttft_on, 3),
+                  "pages_after_admission_sharing_off": pages_off,
+                  "pages_after_admission_sharing_on": pages_on,
+                  "capacity_ratio": round(pages_off / pages_on, 3),
+                  "prefix_hit_rate": round(
+                      st["hit_tokens"] /
+                      (st["hit_tokens"] + st["miss_tokens"]), 3),
+                  "shared_pages_mapped": st["shared_pages"],
+                  "prefill_compiles": LLMEngine.prefill_compiles(),
+                  "decode_compiles": LLMEngine.decode_compiles()}}
+
+
 def jnp_bf16():
     import jax.numpy as jnp
     return jnp.bfloat16
@@ -1013,6 +1092,7 @@ def main():
                ("bench_engine", bench_engine),
                ("bench_serving_quant", bench_serving_quant),
                ("bench_serving_metrics", bench_serving_metrics),
+               ("bench_serving_prefix", bench_serving_prefix),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

@@ -33,6 +33,15 @@ matmul outputs); models already converted by
 ``paddle_tpu.quantization.quantize_model`` are picked up as-is.  Both
 knobs keep the no-recompile property: the quantized programs' shapes
 are still fixed by the engine geometry alone.
+
+Automatic prefix caching (``enable_prefix_caching=``, default on):
+admission looks up the longest cached page-aligned prefix of the
+prompt in the paged cache's chain-hash index, maps those pages into
+the new slot's table (host-side only), and runs the chunked prefill
+over the uncached tail — shared system prompts / few-shot templates
+prefill ONCE and cost one set of pages however many requests carry
+them.  Sharing is page-table indirection only: the prefill/decode
+programs are unchanged, so ``prefill_compiles() == 1`` still holds.
 """
 from __future__ import annotations
 
@@ -363,7 +372,8 @@ class LLMEngine:
                  steps_per_sync: int = 1,
                  kv_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
-                 enable_metrics: bool = True):
+                 enable_metrics: bool = True,
+                 enable_prefix_caching: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -389,6 +399,12 @@ class LLMEngine:
         self.max_len = max_len
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
+        self.enable_prefix_caching = bool(enable_prefix_caching)
+        # host-side prefix-cache stats (kept even with metrics off —
+        # the bench and tests read them directly)
+        self.prefix_stats = {"hit_tokens": 0, "miss_tokens": 0,
+                             "shared_pages": 0, "hit_requests": 0,
+                             "miss_requests": 0}
         c = model.config
         self.eps = c.rms_norm_eps
         self.kvh = c.num_key_value_heads
@@ -517,6 +533,23 @@ class LLMEngine:
                 "llm_engine_batch_occupancy",
                 "Active requests / max_seqs in the last decode "
                 "window.", lbl).labels(eid),
+            "prefix_hit_tokens": reg.counter(
+                "llm_engine_prefix_hit_tokens_total",
+                "Prompt tokens served from cached prefix pages (no "
+                "prefill compute).", lbl).labels(eid),
+            "prefix_miss_tokens": reg.counter(
+                "llm_engine_prefix_miss_tokens_total",
+                "Prompt tokens that ran chunked prefill.",
+                lbl).labels(eid),
+            "prefix_shared_pages": reg.counter(
+                "llm_engine_prefix_shared_pages_total",
+                "Cached pages mapped read-shared into admitted "
+                "slots.", lbl).labels(eid),
+            "prefix_hit_rate": reg.gauge(
+                "llm_engine_prefix_cache_hit_rate",
+                "Cumulative cached / total prompt tokens (0 when "
+                "prefix caching is off or nothing admitted).",
+                lbl).labels(eid),
         }
         # compile-count gauges are process-global (the jit caches are),
         # unlabeled: any drift past 1 means a recompile regression —
@@ -544,7 +577,17 @@ class LLMEngine:
         program (each chunk fills exactly one page in-graph), so a
         mixed-length request stream costs ONE prefill compile total
         (assert with ``prefill_compiles()``) — round 2 recompiled per
-        prompt, round 4 per power-of-two bucket."""
+        prompt, round 4 per power-of-two bucket.
+
+        Automatic prefix caching (on by default): the longest cached
+        page-aligned prefix of the prompt is mapped into the slot's
+        page table WITHOUT touching the device, and the chunk loop
+        runs only over the uncached tail — same compiled program, it
+        just starts at a later chunk, so ``prefill_compiles() == 1``
+        survives.  The cacheable prefix is capped strictly below the
+        prompt length: the chunk holding the last prompt token always
+        recomputes (into a private page), which is what produces the
+        first-token logits even when the whole prompt is cached."""
         import jax
         import jax.numpy as jnp
 
@@ -561,48 +604,73 @@ class LLMEngine:
                 f"prompt ({plen}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the engine/model limit "
                 f"{limit}")
-        req.slot = self.cache.allocate(total)
+        P = self.cache.page_size
+        cached, shared_pages = 0, []
+        if self.enable_prefix_caching:
+            # cap at the last page boundary STRICTLY below plen so the
+            # final chunk (the one whose logits seed decoding) always
+            # runs — shared pages stay immutable, logits stay real
+            cacheable = ((plen - 1) // P) * P
+            cached, shared_pages = self.cache.lookup_prefix(
+                req.prompt[:cacheable])
+        req.slot = self.cache.allocate(total, shared_pages=shared_pages)
 
         # CHUNKED ragged prefill (round 5): page-size chunks, each one
         # filling exactly one page in-graph — ONE compiled program for
         # any prompt-length mix (prefill_compiles() == 1), vs the r4
-        # power-of-two buckets (one compile per bucket)
-        P = self.cache.page_size
+        # power-of-two buckets (one compile per bucket).  Cached-prefix
+        # chunks are skipped: their pages are already written.
         table = np.asarray(self.cache.page_table[req.slot])
         n_chunks = -(-plen // P)
         logits = None
-        with RecordEvent("llm_engine.prefill"):
-            for ci in range(n_chunks):
-                base = ci * P
-                chunk = np.zeros(P, np.int32)
-                real = min(P, plen - base)
-                chunk[:real] = np.asarray(req.prompt[base:base + real],
-                                          np.int32)
-                (logits, self.cache.k_pages, self.cache.v_pages,
-                 self.cache.k_scales, self.cache.v_scales) = \
-                    _paged_prefill_chunk(
-                        self._stack, self._norm_w, self._head_w,
-                        self._embed_w, self._rope_prefill,
-                        self.cache.k_pages, self.cache.v_pages,
-                        self.cache.k_scales, self.cache.v_scales,
-                        jnp.asarray(chunk),
-                        jnp.asarray(table), jnp.int32(base),
-                        jnp.int32(int(table[ci])),
-                        jnp.int32(min(plen - 1 - base, P - 1)),
-                        eps=self.eps, kvh=self.kvh,
-                        head_dim=self.head_dim,
-                        transpose_head=self._tied)
-            self.cache.set_len(req.slot, plen)
+        try:
+            with RecordEvent("llm_engine.prefill"):
+                for ci in range(cached // P, n_chunks):
+                    base = ci * P
+                    chunk = np.zeros(P, np.int32)
+                    real = min(P, plen - base)
+                    chunk[:real] = np.asarray(
+                        req.prompt[base:base + real], np.int32)
+                    (logits, self.cache.k_pages, self.cache.v_pages,
+                     self.cache.k_scales, self.cache.v_scales) = \
+                        _paged_prefill_chunk(
+                            self._stack, self._norm_w, self._head_w,
+                            self._embed_w, self._rope_prefill,
+                            self.cache.k_pages, self.cache.v_pages,
+                            self.cache.k_scales, self.cache.v_scales,
+                            jnp.asarray(chunk),
+                            jnp.asarray(table), jnp.int32(base),
+                            jnp.int32(int(table[ci])),
+                            jnp.int32(min(plen - 1 - base, P - 1)),
+                            eps=self.eps, kvh=self.kvh,
+                            head_dim=self.head_dim,
+                            transpose_head=self._tied)
+                self.cache.set_len(req.slot, plen)
+                if self.enable_prefix_caching:
+                    # publish this prompt's full pages (the just-
+                    # prefilled ones included) for future requests
+                    self.cache.register_prefix(
+                        req.slot, req.prompt, upto=(plen // P) * P)
 
-            self._key, sub = jax.random.split(self._key)
-            from ..nn.generation import sample_logits
-            first_tok, _ = sample_logits(
-                logits[None], sub, strategy=self.decode_strategy,
-                top_k=self.top_k, top_p=self.top_p,
-                temperature=self.temperature)
-            first = int(np.asarray(first_tok)[0])
+                self._key, sub = jax.random.split(self._key)
+                from ..nn.generation import sample_logits
+                first_tok, _ = sample_logits(
+                    logits[None], sub, strategy=self.decode_strategy,
+                    top_k=self.top_k, top_p=self.top_p,
+                    temperature=self.temperature)
+                first = int(np.asarray(first_tok)[0])
+        except BaseException:
+            # chunked prefill / sampling failed: the slot (and its
+            # page references) must not leak — release, then re-raise
+            self.cache.release(req.slot)
+            raise
         req.out.append(first)
         self.requests[rid] = req
+        st = self.prefix_stats
+        st["hit_tokens"] += cached
+        st["miss_tokens"] += plen - cached
+        st["shared_pages"] += len(shared_pages)
+        st["hit_requests" if cached else "miss_requests"] += 1
         if self._metrics is not None:
             m = self._metrics
             # the int() above synced the device: TTFT is honest
@@ -610,6 +678,12 @@ class LLMEngine:
             m["prompt_tokens"].inc(plen)
             m["generated_tokens"].inc(1)
             m["requests"].inc()
+            m["prefix_hit_tokens"].inc(cached)
+            m["prefix_miss_tokens"].inc(plen - cached)
+            m["prefix_shared_pages"].inc(len(shared_pages))
+            seen = st["hit_tokens"] + st["miss_tokens"]
+            m["prefix_hit_rate"].set(st["hit_tokens"] / seen
+                                     if seen else 0.0)
             self._record_compiles()
         # the prefill-produced token counts toward the limits too
         if (req.eos is not None and first == req.eos) or \
@@ -720,7 +794,24 @@ class LLMEngine:
         return bool(self._active)
 
     def result(self, rid) -> List[int]:
-        return list(self.requests[rid].out)
+        """Final token list of a RETIRED request.
+
+        Retirement contract: a request retires when it hits EOS or its
+        max_new_tokens budget (its pages are released then); until
+        that point its tokens stream out of ``step()``'s return value
+        and ``result`` raises.  Unknown rids raise too — both are
+        clear errors instead of a bare KeyError or a silently partial
+        read.  Results stay readable after retirement for the
+        engine's lifetime."""
+        enforce(rid in self.requests,
+                f"unknown request id {rid!r} (never admitted to this "
+                f"engine)")
+        req = self.requests[rid]
+        enforce(req.done,
+                f"request {rid!r} is still generating ({len(req.out)} "
+                f"tokens so far) — consume step() output to stream, "
+                f"or call result() after it retires")
+        return list(req.out)
 
     # -- observability ---------------------------------------------------------
     @staticmethod
@@ -743,6 +834,8 @@ class LLMEngine:
         invariants.  Works with ``enable_metrics=False`` too (the
         registry-backed series are then absent; compile counts and
         page stats are always available)."""
+        seen = self.prefix_stats["hit_tokens"] + \
+            self.prefix_stats["miss_tokens"]
         snap = {
             "engine": self.engine_id,
             "prefill_compiles": self.prefill_compiles(),
@@ -750,6 +843,11 @@ class LLMEngine:
             "kv_cache": self.cache.metrics_snapshot(),
             "kv_page_utilization": self.cache.page_utilization(),
             "active_requests": len(self._active),
+            "prefix_caching": dict(
+                self.prefix_stats,
+                enabled=self.enable_prefix_caching,
+                hit_rate=(self.prefix_stats["hit_tokens"] / seen
+                          if seen else 0.0)),
         }
         if self._metrics is not None:
             m = self._metrics

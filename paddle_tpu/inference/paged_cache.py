@@ -17,10 +17,27 @@ write_prefill/append quantize on the way in, attend dequantizes inside
 the kernel — KV HBM bytes drop ~2× vs fp16 / ~4× vs fp32, which is the
 whole game for bandwidth-bound TPU decode and for page capacity at a
 fixed HBM budget.
+
+Automatic prefix caching (vLLM-style, host-side only): pages are
+REF-COUNTED, and full, immutable prefill pages can be registered in a
+hash index keyed by the CHAIN of token-block hashes — ``[sys][A]`` and
+``[sys][B]`` share exactly the ``[sys]`` pages, because block k's key
+digests block k-1's key.  ``lookup_prefix`` walks the chain,
+``allocate(shared_pages=...)`` maps the hits into a new slot's page
+table without touching the device, and ``release`` keeps unreferenced
+registered pages CACHED (an LRU pool) instead of freeing them: a later
+``allocate``/``extend`` evicts LRU-oldest only when the free list runs
+dry.  Writes into a shared page copy-on-write (``extend`` grabs a
+fresh page and device-copies the row — scales included — before any
+mutation), so shared content is immutable by construction.  The int8
+scale pools are indexed by the same physical page ids, so quantized
+serving shares scales with their pages for free.
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -31,6 +48,15 @@ from ..observability import get_registry
 __all__ = ["PagedKVCache"]
 
 _CACHE_IDS = itertools.count()
+
+
+def _chain_hash(prev: bytes, tokens) -> bytes:
+    """Key for one full token block given the previous block's key —
+    chaining makes the key identify the whole prefix, not the block in
+    isolation (so equal blocks under different prefixes never alias)."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
 
 
 class PagedKVCache:
@@ -66,6 +92,14 @@ class PagedKVCache:
         self._table = np.zeros((max_seqs, self.max_pages_per_seq),
                                np.int32)
         self._used = [False] * max_seqs
+        # prefix caching state: per-page reference counts (how many
+        # slots map the page), the chain-hash index over registered
+        # full prefill pages, and the LRU pool of registered pages with
+        # ref 0 — cached content kept warm until page pressure evicts
+        self._ref = np.zeros(n_pages, np.int64)
+        self._index: Dict[bytes, int] = {}       # chain key -> page
+        self._page_key: Dict[int, bytes] = {}    # page -> chain key
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
         # page-pressure telemetry (host-side counters — negligible next
         # to the device work these methods bracket); one label set per
         # cache instance so concurrent engines don't blur each other
@@ -86,32 +120,134 @@ class PagedKVCache:
             lbl).labels(self.cache_id)
         self._m_util = reg.gauge(
             "kv_cache_page_utilization",
-            "Fraction of usable pages in use (page 0 is the reserved "
-            "pad page).", lbl).labels(self.cache_id)
+            "Fraction of usable pages referenced by live slots (page 0 "
+            "is the reserved pad page; prefix-cached LRU pages count "
+            "as reclaimable, not in use).", lbl).labels(self.cache_id)
+        self._m_evict = reg.counter(
+            "kv_cache_prefix_evicted_pages_total",
+            "Prefix-cached pages evicted from the LRU pool under page "
+            "pressure.", lbl).labels(self.cache_id)
+        self._m_cow = reg.counter(
+            "kv_cache_cow_pages_total",
+            "Copy-on-write page copies (a write targeted a shared "
+            "page).", lbl).labels(self.cache_id)
+        self._m_cached = reg.gauge(
+            "kv_cache_prefix_cached_pages",
+            "Registered prefix pages currently unreferenced (the LRU "
+            "pool).", lbl).labels(self.cache_id)
 
     def page_utilization(self) -> float:
-        """In-use fraction of the usable pool (excludes pad page 0)."""
+        """Referenced fraction of the usable pool (excludes pad page 0
+        and counts prefix-cached LRU pages as reclaimable — they are
+        handed back by eviction before any allocation can fail)."""
         usable = self.n_pages - 1
-        return 1.0 - len(self._free) / usable if usable else 0.0
+        if not usable:
+            return 0.0
+        return 1.0 - (len(self._free) + len(self._lru)) / usable
 
     def _track_pages(self):
         self._m_util.set(self.page_utilization())
+        self._m_cached.set(len(self._lru))
+
+    # -- prefix-caching internals ----------------------------------------------
+    def _unregister(self, pg: int):
+        key = self._page_key.pop(pg)
+        del self._index[key]
+
+    def _grab_page(self, what: str) -> int:
+        """One page off the free list, evicting the LRU-oldest cached
+        prefix page when the list is dry; counts the OOM (and leaves
+        the gauges honest) before raising when neither pool has one."""
+        if self._free:
+            pg = self._free.pop()
+        elif self._lru:
+            pg, _ = self._lru.popitem(last=False)      # oldest first
+            self._unregister(pg)
+            self._m_evict.inc()
+        else:
+            self._m_oom.inc()
+            self._track_pages()
+            enforce(False, f"paged cache OOM on {what}: no free or "
+                           f"evictable pages")
+        self._m_alloc.inc()
+        self._ref[pg] = 1
+        return pg
+
+    def _unref(self, pg: int) -> bool:
+        """Drop one reference; True if the page went back to the free
+        list (registered pages park in the LRU pool instead)."""
+        self._ref[pg] -= 1
+        if self._ref[pg] > 0:
+            return False
+        if pg in self._page_key:
+            self._lru[pg] = None                       # newest at end
+            return False
+        self._free.append(pg)
+        return True
+
+    def _copy_page(self, src: int, dst: int):
+        """Device-copy one physical page (both pools, and the scale
+        rows when quantized — scales travel with their pages)."""
+        self.k_pages = self.k_pages.at[:, :, dst].set(
+            self.k_pages[:, :, src])
+        self.v_pages = self.v_pages.at[:, :, dst].set(
+            self.v_pages[:, :, src])
+        if self.kv_dtype == "int8":
+            self.k_scales = self.k_scales.at[:, :, dst].set(
+                self.k_scales[:, :, src])
+            self.v_scales = self.v_scales.at[:, :, dst].set(
+                self.v_scales[:, :, src])
+
+    def _make_private(self, slot: int, idx: int):
+        """Copy-on-write guard before writing into the slot's idx-th
+        page: a shared page (ref > 1) is copied to a fresh page first;
+        a solely-owned but registered page just unregisters (its cached
+        content is about to diverge from the indexed prefix)."""
+        pg = self._pages[slot][idx]
+        if self._ref[pg] > 1:
+            npg = self._grab_page("copy-on-write")
+            self._copy_page(pg, npg)
+            self._unref(pg)
+            self._m_release.inc()
+            self._pages[slot][idx] = npg
+            self._table[slot, idx] = npg
+            self._m_cow.inc()
+        elif pg in self._page_key:
+            self._unregister(pg)
 
     # -- host-side accounting --------------------------------------------------
-    def allocate(self, n_tokens: int) -> int:
+    def allocate(self, n_tokens: int, shared_pages=()) -> int:
         """Reserve a sequence slot with capacity for n_tokens; returns
-        the slot id (batch row for the kernel)."""
+        the slot id (batch row for the kernel).  ``shared_pages``
+        (from ``lookup_prefix``) are mapped read-shared into the front
+        of the slot's page table — a reference each, no device work —
+        and only the remainder comes off the free list."""
         free_slots = [i for i, u in enumerate(self._used) if not u]
         enforce(free_slots, "paged cache: all sequence slots in use")
         slot = free_slots[0]
         need = (n_tokens + self.page_size - 1) // self.page_size
-        if len(self._free) < need:
+        shared = list(shared_pages)
+        enforce(len(shared) <= need,
+                f"paged cache: {len(shared)} shared pages exceed the "
+                f"{need}-page capacity request")
+        # pin the shared pages FIRST so grabbing the remainder can
+        # never evict them out from under this allocation
+        for pg in shared:
+            self._ref[pg] += 1
+            if pg in self._lru:
+                del self._lru[pg]
+        avail = len(self._free) + len(self._lru)
+        if avail < need - len(shared):
             self._m_oom.inc()
-        enforce(len(self._free) >= need,
-                f"paged cache OOM: need {need} pages, "
-                f"{len(self._free)} free")
-        pages = [self._free.pop() for _ in range(need)]
-        self._m_alloc.inc(need)
+            for pg in reversed(shared):
+                self._unref(pg)
+            self._track_pages()
+            enforce(False,
+                    f"paged cache OOM: need {need - len(shared)} "
+                    f"pages, {avail} free/evictable")
+        self._m_alloc.inc(len(shared))      # the shared references
+        pages = shared + [self._grab_page("allocate")
+                          for _ in range(need - len(shared))]
         self._used[slot] = True
         self._pages[slot] = pages
         self._lens[slot] = 0
@@ -121,29 +257,92 @@ class PagedKVCache:
         return slot
 
     def extend(self, slot: int, n_tokens: int = 1):
-        """Ensure capacity for n_tokens more; grabs pages as needed."""
-        have = len(self._pages[slot]) * self.page_size
-        need_total = int(self._lens[slot]) + n_tokens
+        """Ensure capacity for n_tokens more; grabs pages as needed.
+        Already-attached pages the new tokens will land in are made
+        private first (copy-on-write), so appends after a shared
+        prefix can never mutate another sequence's view."""
+        pages = self._pages[slot]
+        cur = int(self._lens[slot])
+        need_total = cur + n_tokens
+        if n_tokens > 0 and pages:
+            first = cur // self.page_size
+            last = (need_total - 1) // self.page_size
+            for idx in range(first, min(last, len(pages) - 1) + 1):
+                self._make_private(slot, idx)
+        have = len(pages) * self.page_size
         while have < need_total:
-            if not self._free:
-                self._m_oom.inc()
-            enforce(self._free, "paged cache OOM on extend")
-            pg = self._free.pop()
-            self._m_alloc.inc()
-            idx = len(self._pages[slot])
-            self._pages[slot].append(pg)
+            pg = self._grab_page("extend")
+            idx = len(pages)
+            pages.append(pg)
             self._table[slot, idx] = pg
             have += self.page_size
         self._track_pages()
 
     def release(self, slot: int):
+        """Drop the slot's page references.  Unregistered pages return
+        to the free list; registered prefix pages with no remaining
+        reference stay cached in the LRU pool (still allocatable —
+        eviction reclaims them oldest-first under pressure)."""
         pages = self._pages.pop(slot)
-        self._free.extend(reversed(pages))
+        for pg in reversed(pages):
+            self._unref(pg)
         self._m_release.inc(len(pages))
         self._used[slot] = False
         self._lens[slot] = 0
         self._table[slot, :] = 0
         self._track_pages()
+
+    # -- prefix caching (public) -----------------------------------------------
+    def lookup_prefix(self, token_ids) -> Tuple[int, List[int]]:
+        """Longest page-aligned cached prefix of ``token_ids``: walks
+        the chain of full-page block hashes through the index and
+        returns (n_cached_tokens, pages).  Pure host work — pass the
+        pages to ``allocate(shared_pages=...)`` to map them."""
+        token_ids = list(token_ids)
+        P = self.page_size
+        key = b""
+        pages: List[int] = []
+        for i in range(len(token_ids) // P):
+            key = _chain_hash(key, token_ids[i * P:(i + 1) * P])
+            pg = self._index.get(key)
+            if pg is None:
+                break
+            pages.append(pg)
+        return len(pages) * P, pages
+
+    def register_prefix(self, slot: int, token_ids, upto: Optional[int]
+                        = None) -> int:
+        """Publish the slot's full, already-written prefill pages into
+        the prefix index (first ``upto`` tokens of ``token_ids``,
+        rounded DOWN to whole pages and clamped to the written length).
+        Pages whose chain key is already indexed are skipped — first
+        writer wins, duplicates stay private.  Returns the number of
+        pages newly registered."""
+        P = self.page_size
+        n = len(token_ids) if upto is None else min(upto, len(token_ids))
+        n = min(n, int(self._lens[slot]))
+        key = b""
+        added = 0
+        for i in range(n // P):
+            key = _chain_hash(key, token_ids[i * P:(i + 1) * P])
+            pg = self._pages[slot][i]
+            if key not in self._index and pg not in self._page_key:
+                self._index[key] = pg
+                self._page_key[pg] = key
+                added += 1
+        self._track_pages()
+        return added
+
+    def cached_page_count(self) -> int:
+        """Registered prefix pages currently unreferenced (evictable)."""
+        return len(self._lru)
+
+    def shared_page_count(self) -> int:
+        """Physical pages mapped by more than one slot right now."""
+        return int((self._ref > 1).sum())
+
+    def page_ref_count(self, page: int) -> int:
+        return int(self._ref[page])
 
     def set_len(self, slot: int, n: int):
         """Host-side length after an in-graph prefill wrote the pages
@@ -163,7 +362,9 @@ class PagedKVCache:
         return self._table
 
     def free_page_count(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free plus the prefix-cached LRU
+        pool (reclaimed transparently by eviction)."""
+        return len(self._free) + len(self._lru)
 
     def kv_bytes_per_token(self) -> int:
         """HBM bytes one cached token costs across all layers and both
@@ -184,7 +385,11 @@ class PagedKVCache:
                 "pages_released": int(self._m_release.value),
                 "oom_events": int(self._m_oom.value),
                 "free_pages": self.free_page_count(),
-                "page_utilization": self.page_utilization()}
+                "page_utilization": self.page_utilization(),
+                "prefix_cached_pages": self.cached_page_count(),
+                "prefix_shared_pages": self.shared_page_count(),
+                "prefix_evicted_pages": int(self._m_evict.value),
+                "cow_pages": int(self._m_cow.value)}
 
     # -- device-side ops -------------------------------------------------------
     def _norm_layers(self, k, v, tokens_axis: int):

@@ -1,0 +1,374 @@
+"""Automatic prefix caching: ref-counted copy-on-write page sharing in
+the paged KV cache (ISSUE 3).
+
+Shared prompt prefixes (system prompts, few-shot templates) prefill
+ONCE and cost one set of pages across requests; sharing is page-table
+indirection only, so generated tokens are bit-identical to
+``enable_prefix_caching=False`` and ``prefill_compiles() == 1``
+survives.  Cache-level mechanics (refcounts, COW, LRU eviction) are
+exercised directly on ``PagedKVCache``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.common.errors import InvalidArgumentError
+from paddle_tpu.inference import PagedKVCache
+from paddle_tpu.inference import engine as E
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+P = 8                                     # page size used throughout
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return m
+
+
+def _drain(eng):
+    while eng.has_work():
+        eng.step()
+
+
+def _serve(model, prompts, enable, max_new=4, **kw):
+    eng = LLMEngine(model, max_seqs=8, max_len=64, page_size=P,
+                    n_pages=64, enable_prefix_caching=enable, **kw)
+    for i, p in enumerate(prompts):
+        eng.add_request(f"r{i}", p, max_new_tokens=max_new)
+    _drain(eng)
+    return [eng.result(f"r{i}") for i in range(len(prompts))], eng
+
+
+@pytest.fixture()
+def chunk_counter(monkeypatch):
+    """Counts _paged_prefill_chunk invocations (the jitted fn is
+    looked up as a module global at call time) while keeping the
+    compile-count introspection alive."""
+    orig = E._paged_prefill_chunk
+    calls = {"n": 0}
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    counting._cache_size = orig._cache_size
+    monkeypatch.setattr(E, "_paged_prefill_chunk", counting)
+    return calls
+
+
+class TestPrefixCachingEngine:
+    def test_shared_prefix_prefills_once_tokens_identical(
+            self, model, chunk_counter):
+        """Acceptance: 2-page shared system prompt, 8 requests — the
+        shared pages prefill exactly once (chunk-call count), no new
+        prefill program compiles, tokens bit-identical to sharing
+        off."""
+        sys_prompt = list(range(1, 2 * P + 1))        # exactly 2 pages
+        prompts = [sys_prompt + [40 + i, 3, 7] for i in range(8)]
+
+        off, _ = _serve(model, prompts, enable=False)
+        compiles_before = LLMEngine.prefill_compiles()
+        n_off = chunk_counter["n"]
+        chunk_counter["n"] = 0
+        on, eng = _serve(model, prompts, enable=True)
+        n_on = chunk_counter["n"]
+
+        assert on == off                  # bit-identical greedy tokens
+        # sharing-off prefills 3 chunks per request; sharing-on pays
+        # the 2 shared chunks once: 8*3 vs 3 + 7*1
+        assert n_off == 8 * 3
+        assert n_on == 3 + 7 * 1
+        # the no-recompile invariant survives prefix caching
+        assert LLMEngine.prefill_compiles() == compiles_before
+        st = eng.prefix_stats
+        assert st["hit_requests"] == 7 and st["miss_requests"] == 1
+        assert st["hit_tokens"] == 7 * 2 * P
+        assert st["shared_pages"] == 7 * 2
+        snap = eng.metrics_snapshot()["prefix_caching"]
+        assert snap["enabled"] and 0.0 < snap["hit_rate"] < 1.0
+
+    def test_partial_hit_shares_only_common_pages(self, model,
+                                                  chunk_counter):
+        """[sys][A] vs [sys][B]: only the [sys] pages are shared —
+        the chain hash keys a block by its whole prefix."""
+        sys_prompt = list(range(1, P + 1))            # 1 page
+        pa = sys_prompt + list(range(30, 30 + P))     # 2nd page A
+        pb = sys_prompt + list(range(50, 50 + P))     # 2nd page B
+        # a page-aligned tail would be cacheable; add an unaligned tail
+        pa, pb = pa + [2, 3], pb + [2, 3]
+        off, _ = _serve(model, [pa, pb], enable=False)
+        chunk_counter["n"] = 0
+        on, eng = _serve(model, [pa, pb], enable=True)
+        assert on == off
+        # request 2 hits exactly the 1-page [sys] prefix: its 2nd/3rd
+        # chunks differ, so 3 + 2 chunk calls in total
+        assert chunk_counter["n"] == 3 + 2
+        assert eng.prefix_stats["hit_tokens"] == P
+        assert eng.prefix_stats["shared_pages"] == 1
+
+    def test_full_prompt_hit_recomputes_final_chunk(self, model,
+                                                    chunk_counter):
+        """A page-aligned prompt admitted twice: the whole prompt is
+        cached, but the final chunk recomputes (into a private page)
+        to produce the first-token logits — and the tokens match the
+        uncached run."""
+        prompt = list(range(1, 2 * P + 1))            # exactly 2 pages
+        off, _ = _serve(model, [prompt, prompt], enable=False)
+        chunk_counter["n"] = 0
+        on, eng = _serve(model, [prompt, prompt], enable=True)
+        assert on == off
+        assert off[0] == off[1]
+        # 2 chunks + (1 cached, final chunk recomputed)
+        assert chunk_counter["n"] == 2 + 1
+        assert eng.prefix_stats["hit_tokens"] == P
+
+    def test_mixed_prompt_stream_equivalence(self, model):
+        """A messy stream (nested prefixes, repeats, non-aligned
+        lengths) generates identically with caching on and off."""
+        base = list(range(1, P + 1))
+        prompts = [base + [9], base + [9, 10, 11], base * 2,
+                   base * 2 + [5], [7, 7, 7], base + [9]]
+        off, _ = _serve(model, prompts, enable=False)
+        on, eng = _serve(model, prompts, enable=True)
+        assert on == off
+        assert eng.prefix_stats["hit_tokens"] > 0
+
+    def test_prefix_caching_off_no_sharing_state(self, model):
+        _, eng = _serve(model, [list(range(1, 2 * P + 2))] * 2,
+                        enable=False)
+        assert eng.prefix_stats["hit_tokens"] == 0
+        assert eng.cache.cached_page_count() == 0
+        assert eng.metrics_snapshot()["prefix_caching"]["enabled"] \
+            is False
+
+    def test_cached_pages_counted_free_and_reclaimed(self, model):
+        """Released requests leave registered pages CACHED (still
+        allocatable); the free-page count includes them and a fresh
+        admission reuses them without prefill."""
+        prompt = list(range(1, 2 * P + 2))
+        _, eng = _serve(model, [prompt], enable=True)
+        assert eng.cache.free_page_count() == eng.cache.n_pages - 1
+        assert eng.cache.cached_page_count() == 2
+        eng.add_request("again", prompt, max_new_tokens=2)
+        assert eng.prefix_stats["hit_tokens"] == 2 * P
+        # the cached pages are referenced again, not re-allocated
+        assert eng.cache.cached_page_count() == 0
+        _drain(eng)
+
+    def test_int8_kv_prefix_sharing_equivalence(self, model):
+        """INT8 paged KV: scale rows are indexed by the same physical
+        page ids, so quantized serving shares them with the pages —
+        outputs match the unshared int8 run exactly."""
+        sys_prompt = list(range(1, 2 * P + 1))
+        prompts = [sys_prompt + [40 + i, 3] for i in range(4)]
+        off, _ = _serve(model, prompts, enable=False, kv_dtype="int8")
+        on, eng = _serve(model, prompts, enable=True, kv_dtype="int8")
+        assert on == off
+        assert eng.prefix_stats["hit_tokens"] == 3 * 2 * P
+
+    def test_prefix_metrics_in_registry(self, model):
+        from paddle_tpu.observability import get_registry
+        _, eng = _serve(model, [list(range(1, 2 * P + 2))] * 2,
+                        enable=True)
+        text = get_registry().expose_text()
+        eid = eng.engine_id
+        assert f'llm_engine_prefix_hit_tokens_total{{engine="{eid}"}}' \
+            f' 16' in text
+        assert f'llm_engine_prefix_cache_hit_rate{{engine="{eid}"}}' \
+            in text
+        assert "kv_cache_prefix_evicted_pages_total" in text
+
+
+class TestPrefixCachingCache:
+    """Cache-level mechanics, CPU-only host accounting + eager jnp."""
+
+    def _filled(self, rng, c, tokens, scale=1.0):
+        n = len(tokens)
+        kvh, d = c.k_pages.shape[1], c.k_pages.shape[-1]
+        k = (scale * rng.normal(size=(n, kvh, d))).astype(np.float32)
+        v = (scale * rng.normal(size=(n, kvh, d))).astype(np.float32)
+        slot = c.allocate(n)
+        c.write_prefill(slot, k, v)
+        c.register_prefix(slot, tokens)
+        return slot, k, v
+
+    def test_lookup_chain_is_prefix_sensitive(self):
+        c = PagedKVCache(n_pages=16, page_size=4, n_kv_heads=1,
+                         head_dim=4, max_seqs=4, max_len=32)
+        rng = np.random.default_rng(0)
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        self._filled(rng, c, toks)
+        assert c.lookup_prefix(toks)[0] == 8
+        assert c.lookup_prefix(toks[:4])[0] == 4
+        # same 2nd block under a DIFFERENT first block: no aliasing
+        assert c.lookup_prefix([9, 9, 9, 9] + toks[4:])[0] == 0
+        assert c.lookup_prefix([1, 2, 3])[0] == 0    # sub-page: no hit
+
+    def test_cow_divergence_after_shared_prefix(self):
+        """Appending into a shared page copies it first: the original
+        sequence's view is untouched, refcounts rebalance."""
+        c = PagedKVCache(n_pages=16, page_size=4, n_kv_heads=2,
+                         head_dim=8, max_seqs=4, max_len=32)
+        rng = np.random.default_rng(1)
+        toks = list(range(100, 108))
+        slot_a, _, _ = self._filled(rng, c, toks)
+        n, pages = c.lookup_prefix(toks)
+        assert n == 8
+        slot_b = c.allocate(12, shared_pages=pages)
+        assert c.page_ref_count(pages[0]) == 2
+        assert c.page_ref_count(pages[1]) == 2
+        assert c.shared_page_count() == 2
+        # diverge B inside the shared 2nd page
+        c.set_len(slot_b, 6)
+        kn = rng.normal(size=(1, 2, 8)).astype(np.float32)
+        vn = rng.normal(size=(1, 2, 8)).astype(np.float32)
+        before = np.asarray(c.k_pages[0, :, pages[1]]).copy()
+        c.append(np.array([slot_b]), kn, vn)
+        new_pg = c._pages[slot_b][1]
+        assert new_pg != pages[1]                 # copied, not mutated
+        assert c.page_ref_count(pages[1]) == 1
+        assert int(c.metrics_snapshot()["cow_pages"]) == 1
+        np.testing.assert_array_equal(
+            np.asarray(c.k_pages[0, :, pages[1]]), before)
+        # B's copy carries the prefix rows then the new token at pos 6
+        np.testing.assert_array_equal(
+            np.asarray(c.k_pages[0, :, new_pg, :2]), before[:, :2])
+        np.testing.assert_allclose(
+            np.asarray(c.k_pages[0, :, new_pg, 2]), kn[0], rtol=1e-6)
+        # A still attends over its original pages
+        assert list(c._pages[slot_a]) == pages
+
+    def test_cow_copies_int8_scales_with_page(self):
+        c = PagedKVCache(n_pages=16, page_size=4, n_kv_heads=2,
+                         head_dim=8, max_seqs=4, max_len=32,
+                         kv_dtype="int8")
+        rng = np.random.default_rng(2)
+        toks = list(range(8))
+        self._filled(rng, c, toks, scale=3.0)
+        n, pages = c.lookup_prefix(toks)
+        slot_b = c.allocate(12, shared_pages=pages)
+        c.set_len(slot_b, 5)
+        want_scales = np.asarray(c.k_scales[0, :, pages[1]]).copy()
+        kn = rng.normal(size=(1, 2, 8)).astype(np.float32)
+        c.append(np.array([slot_b]), kn, kn)
+        new_pg = c._pages[slot_b][1]
+        assert new_pg != pages[1]
+        # the copied page brought its scale rows along (position 0 of
+        # the page predates the divergence point, so it must match)
+        np.testing.assert_array_equal(
+            np.asarray(c.k_scales[0, :, new_pg, 0]),
+            want_scales[:, 0])
+
+    def test_refcount_accounting_across_release(self):
+        c = PagedKVCache(n_pages=16, page_size=4, n_kv_heads=1,
+                         head_dim=4, max_seqs=4, max_len=32)
+        rng = np.random.default_rng(3)
+        toks = [5, 6, 7, 8]
+        slot_a, _, _ = self._filled(rng, c, toks)
+        _, pages = c.lookup_prefix(toks)
+        slot_b = c.allocate(8, shared_pages=pages)
+        assert c.page_ref_count(pages[0]) == 2
+        c.release(slot_a)
+        # B still holds the page: cached-but-referenced, NOT evictable
+        assert c.page_ref_count(pages[0]) == 1
+        assert c.cached_page_count() == 0
+        c.release(slot_b)
+        # now unreferenced: parked in the LRU pool, content kept
+        assert c.page_ref_count(pages[0]) == 0
+        assert c.cached_page_count() == 1
+        assert c.lookup_prefix(toks)[0] == 4
+        assert c.free_page_count() == c.n_pages - 1
+        snap = c.metrics_snapshot()
+        assert snap["pages_allocated"] == snap["pages_released"]
+
+    def test_lru_eviction_under_page_pressure(self):
+        """When allocate/extend would OOM, unreferenced cached pages
+        evict oldest-first; referenced shared pages never evict."""
+        c = PagedKVCache(n_pages=5, page_size=4, n_kv_heads=1,
+                         head_dim=4, max_seqs=4, max_len=16)
+        rng = np.random.default_rng(4)
+        t_old, t_new = [1, 2, 3, 4], [9, 8, 7, 6]
+        s1, _, _ = self._filled(rng, c, t_old)
+        c.release(s1)
+        s2, _, _ = self._filled(rng, c, t_new)
+        c.release(s2)
+        assert c.cached_page_count() == 2
+        c.allocate(12)              # 3 pages: 2 free + 1 evicted (LRU)
+        assert c.lookup_prefix(t_old)[0] == 0        # oldest evicted
+        assert c.lookup_prefix(t_new)[0] == 4        # newer survived
+        assert int(c.metrics_snapshot()["prefix_evicted_pages"]) == 1
+        # true exhaustion (no free, no evictable) still OOMs
+        with pytest.raises(InvalidArgumentError):
+            c.allocate(8)
+        assert int(c.metrics_snapshot()["oom_events"]) == 1
+
+    def test_failed_allocate_rolls_back_shared_refs(self):
+        c = PagedKVCache(n_pages=4, page_size=4, n_kv_heads=1,
+                         head_dim=4, max_seqs=4, max_len=16)
+        rng = np.random.default_rng(5)
+        s1, _, _ = self._filled(rng, c, [1, 2, 3, 4])
+        _, pages = c.lookup_prefix([1, 2, 3, 4])
+        with pytest.raises(InvalidArgumentError):
+            c.allocate(16, shared_pages=pages)   # 3 fresh > 2 free
+        # the pinned shared ref was rolled back
+        assert c.page_ref_count(pages[0]) == 1
+        c.release(s1)
+        assert c.cached_page_count() == 1
+
+    def test_extend_oom_keeps_utilization_gauge_honest(self):
+        """A failed extend leaves its already-grabbed pages attached —
+        the utilization gauge must reflect them (tracked BEFORE the
+        raise), not the pre-extend state."""
+        c = PagedKVCache(n_pages=4, page_size=2, n_kv_heads=1,
+                         head_dim=4, max_seqs=2, max_len=8)
+        s = c.allocate(2)
+        c.set_len(s, 2)
+        with pytest.raises(InvalidArgumentError):
+            c.extend(s, 6)          # needs 3 more pages, only 2 free
+        assert len(c._pages[s]) == 3             # 2 were grabbed
+        assert c.page_utilization() == 1.0
+        assert c._m_util.value == 1.0            # gauge saw the grab
+        assert int(c.metrics_snapshot()["oom_events"]) == 1
+
+
+class TestEngineContracts:
+    def test_add_request_failure_releases_slot(self, model,
+                                               monkeypatch):
+        """If chunked prefill or sampling raises after the slot is
+        allocated, the slot and its pages are released before the
+        error propagates (no leak)."""
+        import paddle_tpu.nn.generation as G
+        eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=P)
+        free0 = eng.cache.free_page_count()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected sampling failure")
+
+        monkeypatch.setattr(G, "sample_logits", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.add_request("x", [5, 9, 2], max_new_tokens=4)
+        monkeypatch.undo()
+        assert eng.cache.free_page_count() == free0
+        assert "x" not in eng.requests
+        # the slot is reusable immediately
+        eng.add_request("y", [5, 9, 2], max_new_tokens=2)
+        _drain(eng)
+        assert len(eng.result("y")) == 2
+
+    def test_result_contract(self, model):
+        """result() serves RETIRED requests only; unknown and
+        still-active rids raise clear errors, never a KeyError or a
+        partial read."""
+        eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=P)
+        with pytest.raises(InvalidArgumentError, match="unknown"):
+            eng.result("missing")
+        eng.add_request("a", [5, 9, 2], max_new_tokens=3)
+        with pytest.raises(InvalidArgumentError,
+                           match="still generating"):
+            eng.result("a")
+        _drain(eng)
+        assert len(eng.result("a")) == 3

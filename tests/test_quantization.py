@@ -335,6 +335,35 @@ def test_engine_int8_continuous_batching_join_leave(model):
         assert matches >= len(want) - 1, (rid, got, want)
 
 
+def test_engine_int8_prefix_cache_shares_scale_pools(model):
+    """Prefix caching under kv_dtype='int8' (ISSUE 3): the f32 scale
+    rows are indexed by the same physical page ids as their int8
+    pages, so mapping a cached prefix shares BOTH — the shared-prefix
+    stream must be token-identical to the sharing-off int8 run, and
+    the second admission must map (not re-quantize) the prefix."""
+    from paddle_tpu.inference.engine import LLMEngine
+    sys_prompt = list(range(1, 17))                   # 2 pages at P=8
+
+    def run(enable):
+        eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8,
+                        n_pages=32, kv_dtype="int8",
+                        enable_prefix_caching=enable)
+        for i in range(3):
+            eng.add_request(f"r{i}", sys_prompt + [40 + i, 7],
+                            max_new_tokens=4)
+        while eng.has_work():
+            eng.step()
+        return [eng.result(f"r{i}") for i in range(3)], eng
+
+    off, _ = run(False)
+    on, eng = run(True)
+    assert on == off
+    assert eng.prefix_stats["hit_tokens"] == 2 * 16
+    assert eng.prefix_stats["shared_pages"] == 2 * 2
+    # the cached prefix pages (and scale rows) survive retirement
+    assert eng.cache.cached_page_count() == 2
+
+
 def test_engine_quantized_model_storage_reused(model):
     """A quantize_model'd model feeds the engine its int8 storage
     directly (no fp rehydration): the stacked weights arrive as
