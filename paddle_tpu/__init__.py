@@ -40,6 +40,7 @@ from . import hapi
 from .hapi import Model
 from .hapi import callbacks
 from . import inference
+from . import serving
 from . import vision
 from . import sparse
 from . import audio

@@ -78,6 +78,7 @@ class GenRequest:
         self.out: List[int] = []
         self.slot: Optional[int] = None
         self.done = False
+        self.cancelled = False
 
 
 def _wout(w) -> int:
@@ -526,6 +527,10 @@ class LLMEngine:
             "requests": reg.counter(
                 "llm_engine_requests_total",
                 "Requests admitted.", lbl).labels(eid),
+            "aborted": reg.counter(
+                "llm_engine_aborted_total",
+                "Requests cancelled via abort() before finishing.",
+                lbl).labels(eid),
             "queue_depth": reg.gauge(
                 "llm_engine_queue_depth",
                 "Requests active in the decode batch.", lbl).labels(eid),
@@ -793,16 +798,57 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self._active)
 
+    # -- admission-control introspection ---------------------------------------
+    def free_slots(self) -> int:
+        """Sequence slots available for admission right now.  Paired
+        with ``cache.free_pages()`` this lets a scheduler decide
+        admission WITHOUT try/except on the OOM raise: a request fits
+        iff ``free_slots() >= 1`` and ``cache.free_pages() >=
+        ceil((len(prompt) + max_new_tokens) / page_size)`` (the engine
+        reserves the full page budget at admission, so a request that
+        admits can always decode to its budget)."""
+        return self.cache.free_slot_count()
+
+    def abort(self, rid) -> bool:
+        """Cancel a request: release its KV pages and retire it with
+        ``cancelled=True`` so ``result()`` has a defined answer (the
+        tokens produced before the abort).  Returns True if the
+        request was live and is now cancelled, False if it had already
+        retired (idempotent — a race between natural completion and a
+        client disconnect is not an error).  Unknown rids raise."""
+        enforce(rid in self.requests,
+                f"unknown request id {rid!r} (never admitted to this "
+                f"engine)")
+        req = self.requests[rid]
+        if req.done:
+            return False
+        req.done = True
+        req.cancelled = True
+        if req in self._active:
+            self._active.remove(req)
+            self.cache.release(req.slot)
+        if self._metrics is not None:
+            self._metrics["aborted"].inc()
+            self._metrics["queue_depth"].set(len(self._active))
+        return True
+
     def result(self, rid) -> List[int]:
         """Final token list of a RETIRED request.
 
-        Retirement contract: a request retires when it hits EOS or its
-        max_new_tokens budget (its pages are released then); until
-        that point its tokens stream out of ``step()``'s return value
-        and ``result`` raises.  Unknown rids raise too — both are
-        clear errors instead of a bare KeyError or a silently partial
-        read.  Results stay readable after retirement for the
-        engine's lifetime."""
+        Retirement contract: a request retires when it hits EOS, its
+        max_new_tokens budget (its pages are released then), or is
+        ``abort()``-ed (check ``requests[rid].cancelled`` to tell a
+        partial stream from a completed one); until that point its
+        tokens stream out of ``step()``'s return value and ``result``
+        raises.  Unknown rids raise too — both are clear errors
+        instead of a bare KeyError or a silently partial read.
+
+        Retention: results stay readable after retirement for the
+        engine's lifetime — the entry is only dropped by
+        ``pop_result()``.  Long-running servers MUST use
+        ``pop_result`` (the serving scheduler does), or the
+        ``requests`` map grows by one retired entry per request
+        forever."""
         enforce(rid in self.requests,
                 f"unknown request id {rid!r} (never admitted to this "
                 f"engine)")
@@ -812,6 +858,16 @@ class LLMEngine:
                 f"tokens so far) — consume step() output to stream, "
                 f"or call result() after it retires")
         return list(req.out)
+
+    def pop_result(self, rid) -> List[int]:
+        """``result(rid)``, then forget the request — the
+        memory-retention primitive for long-running serving (a
+        week-long server that never pops grows ``requests`` without
+        bound).  Same contract as ``result``: only retired rids
+        pop."""
+        out = self.result(rid)
+        del self.requests[rid]
+        return out
 
     # -- observability ---------------------------------------------------------
     @staticmethod
@@ -843,6 +899,7 @@ class LLMEngine:
             "kv_cache": self.cache.metrics_snapshot(),
             "kv_page_utilization": self.cache.page_utilization(),
             "active_requests": len(self._active),
+            "free_slots": self.free_slots(),
             "prefix_caching": dict(
                 self.prefix_stats,
                 enabled=self.enable_prefix_caching,

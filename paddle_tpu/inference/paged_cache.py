@@ -366,6 +366,20 @@ class PagedKVCache:
         pool (reclaimed transparently by eviction)."""
         return len(self._free) + len(self._lru)
 
+    def free_pages(self) -> int:
+        """Admission-control view of capacity: pages an ``allocate``
+        can obtain RIGHT NOW — the free list plus the evictable
+        prefix-cached LRU pool.  A scheduler that checks
+        ``free_pages() >= ceil(total_tokens / page_size)`` before
+        admitting can never see the OOM raise (the engine reserves a
+        request's full page budget at admission, so decode never grabs
+        more)."""
+        return len(self._free) + len(self._lru)
+
+    def free_slot_count(self) -> int:
+        """Sequence slots not currently bound to a live request."""
+        return sum(1 for u in self._used if not u)
+
     def kv_bytes_per_token(self) -> int:
         """HBM bytes one cached token costs across all layers and both
         pools — int8 counts its f32 scale rows, so capacity claims stay

@@ -1,0 +1,30 @@
+"""``paddle_tpu.serving`` — the runtime between user traffic and the
+``LLMEngine``.
+
+Three layers, composable bottom-up:
+
+* ``Scheduler`` — continuous-batching loop over ONE engine: bounded
+  priority queue, capacity-checked admission (a full KV cache queues
+  instead of raising), deadlines / max-queue-time with deadline-miss
+  accounting, load shedding (``RejectedError``), cancellation, and
+  graceful drain.  Adds policy, never math: tokens are bit-identical
+  to driving the engine directly and ``prefill_compiles() == 1``
+  survives.
+* ``ReplicaRouter`` — least-loaded routing across N scheduler-wrapped
+  replicas with per-replica circuit breaking, retry-with-backoff
+  failover, and a fault-injection hook.
+* ``HTTPFrontend`` / ``start_http_frontend`` — stdlib streaming HTTP:
+  ``POST /v1/completions`` (chunked per-step token streaming),
+  ``GET /healthz``, ``GET /metrics`` (Prometheus text via the
+  observability registry).
+
+All three report through the process-global ``MetricRegistry``
+(queue-wait histogram, shed/abort/deadline-miss/retry counters,
+per-replica load gauges) — one ``/metrics`` scrape covers the stack.
+"""
+from .scheduler import RejectedError, ScheduledRequest, Scheduler
+from .router import ReplicaRouter
+from .server import HTTPFrontend, start_http_frontend
+
+__all__ = ["Scheduler", "ScheduledRequest", "RejectedError",
+           "ReplicaRouter", "HTTPFrontend", "start_http_frontend"]

@@ -1,0 +1,292 @@
+"""Multi-replica router: spread requests across N scheduler-wrapped
+engine replicas.
+
+One engine saturates one chip; traffic beyond that is served by
+REPLICAS (same weights, independent KV pools).  The router is the
+host-side policy layer in front of them:
+
+* least-loaded routing — a request goes to the healthy replica with
+  the fewest waiting + active requests (ties break on replica index);
+* per-replica health with circuit breaking — ``failure_threshold``
+  consecutive submission failures open the replica's circuit for
+  ``cooldown`` seconds (no traffic), after which ONE half-open
+  attempt probes it (success closes the circuit, failure re-opens);
+* retry with exponential backoff — a failed submission moves to the
+  next-best replica; when every candidate has failed this call, the
+  router backs off (``backoff_base`` doubling per round) before
+  re-trying the set, up to ``max_attempts`` attempts total;
+* fault injection (``set_fault``) — tests and chaos drills raise
+  synthetic failures on a chosen replica without touching the engine.
+
+A replica-level ``RejectedError`` (its bounded queue is full) is load
+signal, not failure: the router tries the other replicas but does not
+open the circuit; if ALL replicas reject, the rejection propagates.
+
+Threading mirrors the scheduler: ``submit``/``cancel`` from any
+thread, ``step()``/``run_until_idle`` from the owner's loop thread.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.errors import UnavailableError, enforce
+from ..observability import get_registry
+from .scheduler import RejectedError
+
+__all__ = ["ReplicaRouter"]
+
+_ROUTER_IDS = itertools.count()
+
+
+class _ReplicaState:
+    def __init__(self):
+        self.consecutive_failures = 0
+        self.open_until: Optional[float] = None  # circuit-open deadline
+        self.failures_total = 0
+        self.requests_total = 0
+
+
+class ReplicaRouter:
+    """Least-loaded router over ``Scheduler`` replicas (see module
+    docstring).  ``sleep`` and ``clock`` are injectable so failover
+    tests run without real waiting."""
+
+    def __init__(self, replicas: List, max_attempts: int = 4,
+                 backoff_base: float = 0.05,
+                 failure_threshold: int = 3, cooldown: float = 5.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 enable_metrics: bool = True):
+        enforce(len(replicas) >= 1, "need at least one replica")
+        enforce(max_attempts >= 1, "max_attempts must be >= 1")
+        self.replicas = list(replicas)
+        self.max_attempts = max_attempts
+        self.backoff_base = float(backoff_base)
+        self.failure_threshold = failure_threshold
+        self.cooldown = float(cooldown)
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._lock = threading.RLock()
+        self._state = [_ReplicaState() for _ in self.replicas]
+        self._fault: Dict[int, Callable] = {}
+        self._owner: Dict[object, int] = {}
+        self.retry_count = 0
+        self.router_id = str(next(_ROUTER_IDS))
+        self._init_metrics(enable_metrics)
+
+    # -- metrics ---------------------------------------------------------------
+    def _init_metrics(self, enabled: bool):
+        self._metrics = None
+        if not enabled:
+            return
+        reg = get_registry()
+        rid = self.router_id
+        self._m_retries = reg.counter(
+            "serving_router_retries_total",
+            "Submission attempts retried on another replica (or after "
+            "backoff) following a failure or rejection.",
+            ("router",)).labels(rid)
+        self._m_requests = reg.counter(
+            "serving_router_requests_total",
+            "Requests routed, by replica.", ("router", "replica"))
+        self._m_unhealthy = reg.gauge(
+            "serving_router_replica_unhealthy",
+            "1 while the replica's circuit is open (shedding "
+            "traffic), else 0.", ("router", "replica"))
+        self._m_load = reg.gauge(
+            "serving_router_replica_load",
+            "Waiting + active requests on the replica (the "
+            "least-loaded routing key).", ("router", "replica"))
+        self._metrics = True
+
+    def _track_replica(self, idx: int):
+        if self._metrics is None:
+            return
+        self._m_unhealthy.labels(self.router_id, str(idx)).set(
+            0.0 if self._healthy(idx) else 1.0)
+        self._m_load.labels(self.router_id, str(idx)).set(
+            self._load(idx))
+
+    # -- health / picking ------------------------------------------------------
+    def _healthy(self, idx: int) -> bool:
+        st = self._state[idx]
+        return st.open_until is None or self._clock() >= st.open_until
+
+    def healthy_replicas(self) -> List[int]:
+        with self._lock:
+            return [i for i in range(len(self.replicas))
+                    if self._healthy(i)]
+
+    def _load(self, idx: int) -> int:
+        sched = self.replicas[idx]
+        return sched._n_waiting + len(sched.engine._active)
+
+    def _pick(self, exclude) -> Optional[int]:
+        cands = [i for i in range(len(self.replicas))
+                 if i not in exclude and self._healthy(i)]
+        if not cands:
+            # half-open probe: least-recently-opened circuit first
+            cands = [i for i in range(len(self.replicas))
+                     if i not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda i: (self._load(i), i))
+
+    def _record_failure(self, idx: int):
+        st = self._state[idx]
+        st.consecutive_failures += 1
+        st.failures_total += 1
+        if st.consecutive_failures >= self.failure_threshold:
+            st.open_until = self._clock() + self.cooldown
+        self._track_replica(idx)
+
+    def _record_success(self, idx: int):
+        st = self._state[idx]
+        st.consecutive_failures = 0
+        st.open_until = None
+        st.requests_total += 1
+        self._track_replica(idx)
+
+    # -- fault injection -------------------------------------------------------
+    def set_fault(self, idx: int, fn: Callable) -> None:
+        """Install a fault hook on replica ``idx``: called as
+        ``fn(rid)`` before every submission routed there; raising
+        simulates the replica failing.  Failover paths become testable
+        without breaking a real engine."""
+        self._fault[idx] = fn
+
+    def clear_fault(self, idx: int) -> None:
+        self._fault.pop(idx, None)
+
+    # -- request API -----------------------------------------------------------
+    def submit(self, rid, prompt_ids, **kw) -> int:
+        """Route one request; returns the replica index that accepted
+        it.  Raises ``RejectedError`` when every replica sheds, or
+        ``UnavailableError`` when ``max_attempts`` submissions all
+        fail."""
+        with self._lock:
+            enforce(rid not in self._owner,
+                    f"duplicate request id {rid!r}")
+            tried: set = set()
+            last_err: Optional[BaseException] = None
+            delay = self.backoff_base
+            for attempt in range(self.max_attempts):
+                idx = self._pick(tried)
+                if idx is None:
+                    # whole set failed this round: back off, retry all
+                    tried.clear()
+                    self._sleep(delay)
+                    delay *= 2
+                    idx = self._pick(tried)
+                if attempt > 0:
+                    self.retry_count += 1
+                    if self._metrics is not None:
+                        self._m_retries.inc()
+                try:
+                    fault = self._fault.get(idx)
+                    if fault is not None:
+                        fault(rid)
+                    self.replicas[idx].submit(rid, prompt_ids, **kw)
+                except RejectedError as e:
+                    # load signal, not replica failure — no circuit hit
+                    tried.add(idx)
+                    last_err = e
+                    self._track_replica(idx)
+                except Exception as e:
+                    self._record_failure(idx)
+                    tried.add(idx)
+                    last_err = e
+                else:
+                    self._record_success(idx)
+                    self._owner[rid] = idx
+                    if self._metrics is not None:
+                        self._m_requests.labels(self.router_id,
+                                                str(idx)).inc()
+                    return idx
+            if isinstance(last_err, RejectedError):
+                raise last_err
+            raise UnavailableError(
+                f"request {rid!r} failed on every replica after "
+                f"{self.max_attempts} attempts: {last_err}")
+
+    def _replica_of(self, rid) -> int:
+        enforce(rid in self._owner, f"unknown request id {rid!r}")
+        return self._owner[rid]
+
+    def cancel(self, rid) -> bool:
+        with self._lock:
+            return self.replicas[self._replica_of(rid)].cancel(rid)
+
+    def status(self, rid) -> str:
+        with self._lock:
+            return self.replicas[self._replica_of(rid)].status(rid)
+
+    def result(self, rid) -> List[int]:
+        with self._lock:
+            return self.replicas[self._replica_of(rid)].result(rid)
+
+    def pop_result(self, rid) -> List[int]:
+        with self._lock:
+            idx = self._replica_of(rid)
+            out = self.replicas[idx].pop_result(rid)
+            del self._owner[rid]
+            return out
+
+    def forget(self, rid) -> None:
+        with self._lock:
+            idx = self._replica_of(rid)
+            self.replicas[idx].forget(rid)
+            del self._owner[rid]
+
+    # -- the loop --------------------------------------------------------------
+    def step(self) -> Dict[object, List[int]]:
+        """Step every replica once; returns the merged
+        ``{rid: [new tokens]}`` map (rids are globally unique, so the
+        merge cannot collide)."""
+        out: Dict[object, List[int]] = {}
+        for i, sched in enumerate(self.replicas):
+            if sched.busy():
+                out.update(sched.step())
+            self._track_replica(i)
+        return out
+
+    def busy(self) -> bool:
+        return any(s.busy() for s in self.replicas)
+
+    def run_until_idle(self, max_steps: Optional[int] = None
+                       ) -> Dict[object, List[int]]:
+        out: Dict[object, List[int]] = {}
+        steps = 0
+        while self.busy():
+            for rid, t in self.step().items():
+                out.setdefault(rid, []).extend(t)
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def drain(self) -> None:
+        for sched in self.replicas:
+            sched.stop_admission()
+        self.run_until_idle()
+
+    def metrics_snapshot(self) -> dict:
+        """Router view + every replica's scheduler snapshot."""
+        with self._lock:
+            return {
+                "router": self.router_id,
+                "retries": self.retry_count,
+                "replicas": [{
+                    "replica": i,
+                    "healthy": self._healthy(i),
+                    "load": self._load(i),
+                    "consecutive_failures":
+                        self._state[i].consecutive_failures,
+                    "failures_total": self._state[i].failures_total,
+                    "requests_total": self._state[i].requests_total,
+                    "sched": sched.metrics_snapshot(),
+                } for i, sched in enumerate(self.replicas)],
+            }

@@ -1,0 +1,516 @@
+"""Continuous-batching scheduler — the runtime between user traffic
+and one ``LLMEngine``.
+
+Reference parity: the reference stops at the predictor/engine layer and
+every serving deployment hand-rolls the admit/step/result loop; modern
+TPU serving (PAPERS.md ragged paged attention, MPK's runtime framing)
+gets its throughput from exactly this layer — a policy loop that keeps
+the continuous batch full while bounding what happens under overload.
+
+The ``Scheduler`` wraps ONE engine with:
+
+* a priority-aware waiting queue (lower ``priority`` value runs first,
+  FIFO within a priority class) with a hard bound — when
+  ``max_queue`` requests are already waiting, ``submit`` sheds with
+  ``RejectedError`` instead of growing without limit;
+* capacity-checked admission: a request is admitted only when the
+  engine has a free slot AND the paged cache has the request's full
+  page budget (``ceil((prompt + max_new) / page_size)``) free or
+  evictable — a full cache QUEUES work instead of letting the
+  ``PagedKVCache`` OOM raise escape to the caller.  The check is
+  exact, not heuristic: the engine reserves the whole budget at
+  admission, so an admitted request can always decode to completion;
+* per-request deadlines and max-queue-time: a waiting request whose
+  deadline or queue-time budget expires is shed (it could only waste
+  pages), and a request that finishes late is delivered but counted
+  as a deadline miss — the accounting a goodput bench needs;
+* cancellation (``cancel``) for waiting AND active requests — active
+  ones release their KV pages via ``LLMEngine.abort``;
+* graceful ``drain()``: stop admitting, finish everything in flight.
+
+Determinism contract: the scheduler adds policy, never math — tokens
+are bit-identical to driving the engine directly with the same
+admission order, and admission still runs through the engine's single
+chunked-prefill program (``prefill_compiles() == 1`` survives).
+
+Threading: ``submit``/``cancel`` may be called from any thread (the
+HTTP frontend's handler threads do); all ENGINE work happens inside
+``step()``, which the owner drives from one thread.  Streaming
+callbacks (``on_event``) fire outside the scheduler lock, from the
+thread that called ``step``/``submit``.
+
+Memory: retirement pops the engine entry (``pop_result``) — a
+long-running server does not grow the engine's request map.  The
+scheduler's own finished records live until ``pop_result(rid)``;
+frontends pop when the response is delivered.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.errors import UnavailableError, enforce
+from ..observability import get_registry
+
+__all__ = ["Scheduler", "RejectedError", "ScheduledRequest"]
+
+_SCHED_IDS = itertools.count()
+
+# queue-wait ladder (seconds): admission is host-side, so the
+# interesting range spans "admitted immediately" to "parked behind a
+# long decode burst"
+_QWAIT_BUCKETS = (.001, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
+                  5.0, 15.0, 60.0)
+
+WAITING = "waiting"
+ACTIVE = "active"
+FINISHED = "finished"
+CANCELLED = "cancelled"
+SHED = "shed"
+
+
+class RejectedError(UnavailableError):
+    """The scheduler refused the request (bounded queue full, draining,
+    or expired while waiting) — explicit load shedding, the
+    alternative to unbounded queue growth or an OOM raise."""
+
+
+class ScheduledRequest:
+    """Scheduler-side record of one request's life: queue → engine →
+    result.  ``tokens`` accumulates everything produced (the prefill
+    token included); ``state`` is one of waiting/active/finished/
+    cancelled/shed."""
+
+    def __init__(self, rid, prompt, max_new, eos, priority, deadline,
+                 max_queue_time, submit_t, on_event, seq):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.eos = eos
+        self.priority = priority
+        self.deadline = deadline            # absolute clock value or None
+        self.max_queue_time = max_queue_time
+        self.submit_t = submit_t
+        self.admit_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.on_event = on_event
+        self.seq = seq
+        self.state = WAITING
+        self.tokens: List[int] = []
+        self.deadline_missed = False
+        self.shed_reason: Optional[str] = None
+
+    def __lt__(self, other):                # heapq tie-breaks via seq
+        return (self.priority, self.seq) < (other.priority, other.seq)
+
+
+class Scheduler:
+    """Priority/deadline-aware continuous-batching loop over one
+    ``LLMEngine`` (see module docstring for the policy contract).
+
+    Parameters: ``max_queue`` bounds the WAITING set (active requests
+    are bounded by the engine's ``max_seqs`` already);
+    ``max_queue_time`` is the default queue-time budget (seconds,
+    None = unlimited), overridable per request; ``clock`` is
+    injectable (tests pass a fake) and defaults to
+    ``time.monotonic``."""
+
+    def __init__(self, engine, max_queue: int = 64,
+                 max_queue_time: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 enable_metrics: bool = True):
+        enforce(max_queue >= 1, "max_queue must be >= 1")
+        self.engine = engine
+        self.max_queue = max_queue
+        self.default_max_queue_time = max_queue_time
+        self._clock = clock or time.monotonic
+        self._lock = threading.RLock()
+        self._reqs: Dict[object, ScheduledRequest] = {}
+        self._heap: List[ScheduledRequest] = []
+        self._n_waiting = 0
+        self._seq = itertools.count()
+        self._pending_abort: List[object] = []
+        self._draining = False
+        self.sched_id = str(next(_SCHED_IDS))
+        # host-side shed accounting (kept even with metrics off; the
+        # registry's shed family is shared across schedulers, this is
+        # THIS scheduler's view)
+        self.shed_stats: Dict[str, int] = {}
+        self._init_metrics(enable_metrics)
+
+    # -- metrics ---------------------------------------------------------------
+    def _init_metrics(self, enabled: bool):
+        self._metrics = None
+        if not enabled:
+            return
+        reg = get_registry()
+        sid = self.sched_id
+        lbl = ("sched",)
+        self._metrics = {
+            "queue_wait": reg.histogram(
+                "serving_sched_queue_wait_seconds",
+                "Submit-to-admission wait of admitted requests.",
+                lbl, buckets=_QWAIT_BUCKETS).labels(sid),
+            "admitted": reg.counter(
+                "serving_sched_admitted_total",
+                "Requests admitted into the engine.", lbl).labels(sid),
+            "completed": reg.counter(
+                "serving_sched_completed_total",
+                "Requests that ran to EOS / token budget.",
+                lbl).labels(sid),
+            "shed": reg.counter(
+                "serving_sched_shed_total",
+                "Requests refused or dropped unserved (load "
+                "shedding), by reason.",
+                ("sched", "reason")),
+            "aborts": reg.counter(
+                "serving_sched_abort_total",
+                "Requests cancelled by the client.", lbl).labels(sid),
+            "deadline_miss": reg.counter(
+                "serving_sched_deadline_miss_total",
+                "Requests past their deadline (shed while waiting, or "
+                "delivered late).", lbl).labels(sid),
+            "waiting": reg.gauge(
+                "serving_sched_waiting",
+                "Requests in the bounded waiting queue.",
+                lbl).labels(sid),
+        }
+
+    def _shed_inc(self, reason: str):
+        self.shed_stats[reason] = self.shed_stats.get(reason, 0) + 1
+        if self._metrics is not None:
+            self._metrics["shed"].labels(self.sched_id, reason).inc()
+
+    def _set_waiting_gauge(self):
+        if self._metrics is not None:
+            self._metrics["waiting"].set(self._n_waiting)
+
+    # -- submission / cancellation (any thread) --------------------------------
+    def submit(self, rid, prompt_ids, max_new_tokens: int = 64,
+               eos_token_id: Optional[int] = None, priority: int = 0,
+               deadline: Optional[float] = None,
+               max_queue_time: Optional[float] = None,
+               on_event: Optional[Callable[[dict], None]] = None):
+        """Queue a request.  Raises ``RejectedError`` when the bounded
+        queue is full or the scheduler is draining, and
+        ``InvalidArgumentError`` for requests that could NEVER be
+        admitted (over the engine/model length limit) — an error now
+        beats a request that would wait forever.
+
+        ``deadline`` / ``max_queue_time`` are seconds from submission;
+        ``on_event`` receives ``{"type": "tokens"|"finished"|
+        "cancelled"|"shed", "rid": ..., ...}`` dicts as the request
+        progresses (tokens stream per engine step window)."""
+        eng = self.engine
+        plen = len(list(prompt_ids))
+        enforce(plen >= 1, "empty prompt")
+        enforce(max_new_tokens >= 1, "max_new_tokens must be >= 1")
+        limit = min(eng.max_len,
+                    eng.model.config.max_position_embeddings)
+        enforce(plen + max_new_tokens <= limit,
+                f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine/model limit {limit} — this "
+                f"request can never be admitted")
+        P = eng.cache.page_size
+        need = -(-(plen + max_new_tokens) // P)
+        enforce(need <= eng.cache.n_pages - 1,
+                f"request needs {need} KV pages but the cache holds "
+                f"{eng.cache.n_pages - 1} usable — it can never be "
+                f"admitted")
+        now = self._clock()
+        with self._lock:
+            enforce(rid not in self._reqs,
+                    f"duplicate request id {rid!r} (pop_result "
+                    f"retired ids before reuse)")
+            if self._draining:
+                self._shed_inc("draining")
+                raise RejectedError(
+                    f"scheduler is draining; request {rid!r} rejected")
+            if self._n_waiting >= self.max_queue:
+                self._shed_inc("queue_full")
+                raise RejectedError(
+                    f"waiting queue full ({self.max_queue}); request "
+                    f"{rid!r} shed")
+            mqt = max_queue_time if max_queue_time is not None \
+                else self.default_max_queue_time
+            rec = ScheduledRequest(
+                rid, prompt_ids, max_new_tokens, eos_token_id,
+                priority, now + deadline if deadline is not None
+                else None, mqt, now, on_event, next(self._seq))
+            self._reqs[rid] = rec
+            heapq.heappush(self._heap, rec)
+            self._n_waiting += 1
+            self._set_waiting_gauge()
+        return rid
+
+    def cancel(self, rid) -> bool:
+        """Cancel a waiting or active request.  Waiting requests leave
+        the queue immediately; active ones are aborted (pages
+        released) at the next ``step()`` — engine state is only
+        touched from the stepping thread.  Returns False if the
+        request already finished (idempotent)."""
+        events = []
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            rec = self._reqs[rid]
+            if rec.state == WAITING:
+                rec.state = CANCELLED
+                rec.finish_t = self._clock()
+                self._n_waiting -= 1
+                if self._metrics is not None:
+                    self._metrics["aborts"].inc()
+                self._set_waiting_gauge()
+                self._event(events, rec, {"type": "cancelled",
+                                          "rid": rid, "tokens": []})
+            elif rec.state == ACTIVE:
+                self._pending_abort.append(rid)
+            else:
+                self._dispatch(events)
+                return False
+        self._dispatch(events)
+        return True
+
+    # -- the scheduling loop (one thread) --------------------------------------
+    def step(self) -> Dict[object, List[int]]:
+        """One scheduler iteration: process cancellations, expire
+        stale waiters, admit while capacity allows, run one engine
+        step window, retire finished requests.  Returns
+        ``{rid: [new tokens]}`` for this call (admission's prefill
+        token included) — the same streaming contract as
+        ``LLMEngine.step``."""
+        events: List = []
+        out: Dict[object, List[int]] = {}
+        with self._lock:
+            self._process_aborts(events)
+            self._expire_waiting(events)
+            self._admit(events, out)
+            if self.engine.has_work():
+                for rid, toks in self.engine.step().items():
+                    rec = self._reqs.get(rid)
+                    if rec is None or rec.state != ACTIVE:
+                        continue
+                    rec.tokens.extend(toks)
+                    out.setdefault(rid, []).extend(toks)
+                    self._event(events, rec,
+                                {"type": "tokens", "rid": rid,
+                                 "tokens": list(toks)})
+            self._retire_done(events)
+        self._dispatch(events)
+        return out
+
+    def busy(self) -> bool:
+        """True while anything is waiting, active, or pending abort."""
+        with self._lock:
+            return bool(self._n_waiting or self._pending_abort) or \
+                self.engine.has_work()
+
+    def run_until_idle(self, max_steps: Optional[int] = None
+                       ) -> Dict[object, List[int]]:
+        """Drive ``step()`` until nothing is waiting or active (or
+        ``max_steps`` elapses); returns the union of the per-step
+        token streams."""
+        out: Dict[object, List[int]] = {}
+        steps = 0
+        while self.busy():
+            for rid, t in self.step().items():
+                out.setdefault(rid, []).extend(t)
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def stop_admission(self) -> None:
+        """Refuse further submissions (``submit`` raises
+        ``RejectedError``) — the first half of ``drain``."""
+        with self._lock:
+            self._draining = True
+
+    def drain(self) -> None:
+        """Graceful shutdown: refuse new submissions, then finish
+        every queued and active request."""
+        self.stop_admission()
+        self.run_until_idle()
+
+    # -- results ---------------------------------------------------------------
+    def status(self, rid) -> str:
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            return self._reqs[rid].state
+
+    def result(self, rid) -> List[int]:
+        """Token list of a finished or cancelled request (partial for
+        cancelled — check ``status``).  Shed requests raise
+        ``RejectedError`` (they produced nothing); waiting/active ones
+        raise like ``LLMEngine.result``."""
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            rec = self._reqs[rid]
+            if rec.state == SHED:
+                raise RejectedError(
+                    f"request {rid!r} was shed ({rec.shed_reason})")
+            enforce(rec.state in (FINISHED, CANCELLED),
+                    f"request {rid!r} is {rec.state} — results exist "
+                    f"only after it finishes or is cancelled")
+            return list(rec.tokens)
+
+    def pop_result(self, rid) -> List[int]:
+        """``result(rid)`` + forget the record (the bounded-memory
+        read — frontends pop once the response is delivered)."""
+        out = self.result(rid)
+        with self._lock:
+            del self._reqs[rid]
+        return out
+
+    def forget(self, rid) -> None:
+        """Drop a TERMINAL record (finished/cancelled/shed) without
+        reading it — the teardown path for shed requests, whose
+        ``result`` raises by design.  Waiting/active records refuse
+        (cancel first)."""
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            rec = self._reqs[rid]
+            enforce(rec.state in (FINISHED, CANCELLED, SHED),
+                    f"request {rid!r} is {rec.state} — cancel before "
+                    f"forgetting")
+            del self._reqs[rid]
+
+    def metrics_snapshot(self) -> dict:
+        """Scheduler counters + the wrapped engine's snapshot, one
+        JSON-able dict (the same series land in the global registry
+        under label sched=<id> for /metrics scrapes)."""
+        with self._lock:
+            states: Dict[str, int] = {}
+            for rec in self._reqs.values():
+                states[rec.state] = states.get(rec.state, 0) + 1
+            snap = {
+                "sched": self.sched_id,
+                "waiting": self._n_waiting,
+                "draining": self._draining,
+                "states": states,
+                "shed": dict(self.shed_stats,
+                             total=sum(self.shed_stats.values())),
+                "engine": self.engine.metrics_snapshot(),
+            }
+            if self._metrics is not None:
+                m = self._metrics
+                snap.update({
+                    "admitted": int(m["admitted"].value),
+                    "completed": int(m["completed"].value),
+                    "aborted": int(m["aborts"].value),
+                    "deadline_miss": int(m["deadline_miss"].value),
+                    "queue_wait_seconds":
+                        m["queue_wait"]._snapshot_value(),
+                })
+        return snap
+
+    # -- internals (lock held) -------------------------------------------------
+    def _event(self, events, rec, ev):
+        if rec.on_event is not None:
+            events.append((rec.on_event, ev))
+
+    @staticmethod
+    def _dispatch(events):
+        for cb, ev in events:
+            cb(ev)
+
+    def _process_aborts(self, events):
+        for rid in self._pending_abort:
+            rec = self._reqs.get(rid)
+            if rec is None or rec.state != ACTIVE:
+                continue                     # finished in the meantime
+            if self.engine.abort(rid):
+                rec.tokens = self.engine.pop_result(rid)
+                rec.state = CANCELLED
+                rec.finish_t = self._clock()
+                if self._metrics is not None:
+                    self._metrics["aborts"].inc()
+                self._event(events, rec,
+                            {"type": "cancelled", "rid": rid,
+                             "tokens": list(rec.tokens)})
+        self._pending_abort.clear()
+
+    def _expire_waiting(self, events):
+        """Shed waiting requests whose queue-time budget or deadline
+        has already passed — they can only waste pages."""
+        now = self._clock()
+        for rec in self._heap:
+            if rec.state != WAITING:
+                continue
+            reason = None
+            if rec.max_queue_time is not None and \
+                    now - rec.submit_t > rec.max_queue_time:
+                reason = "queue_timeout"
+            elif rec.deadline is not None and now > rec.deadline:
+                reason = "deadline"
+                rec.deadline_missed = True
+                if self._metrics is not None:
+                    self._metrics["deadline_miss"].inc()
+            if reason is None:
+                continue
+            rec.state = SHED
+            rec.shed_reason = reason
+            rec.finish_t = now
+            self._n_waiting -= 1
+            self._shed_inc(reason)
+            self._event(events, rec, {"type": "shed", "rid": rec.rid,
+                                      "reason": reason})
+        self._set_waiting_gauge()
+
+    def _admit(self, events, out):
+        """Admit from the priority queue while the engine has a free
+        slot and the paged cache holds the head request's FULL page
+        budget.  Head-of-line order is strict (priority, then FIFO):
+        a big high-priority request blocks smaller later ones rather
+        than being starved by them — predictability over packing
+        (bin-packing admission is a ROADMAP open item)."""
+        eng = self.engine
+        P = eng.cache.page_size
+        while self._heap:
+            rec = self._heap[0]
+            if rec.state != WAITING:         # cancelled/shed in queue
+                heapq.heappop(self._heap)
+                continue
+            need = -(-(len(rec.prompt) + rec.max_new) // P)
+            if eng.free_slots() < 1 or eng.cache.free_pages() < need:
+                break
+            heapq.heappop(self._heap)
+            now = self._clock()
+            eng.add_request(rec.rid, rec.prompt,
+                            max_new_tokens=rec.max_new,
+                            eos_token_id=rec.eos)
+            rec.state = ACTIVE
+            rec.admit_t = now
+            self._n_waiting -= 1
+            if self._metrics is not None:
+                self._metrics["queue_wait"].observe(now - rec.submit_t)
+                self._metrics["admitted"].inc()
+            first = list(eng.requests[rec.rid].out)
+            rec.tokens.extend(first)
+            out.setdefault(rec.rid, []).extend(first)
+            self._event(events, rec, {"type": "tokens", "rid": rec.rid,
+                                      "tokens": first})
+        self._set_waiting_gauge()
+
+    def _retire_done(self, events):
+        for rid, ereq in list(self.engine.requests.items()):
+            if not ereq.done:
+                continue
+            rec = self._reqs.get(rid)
+            if rec is None or rec.state != ACTIVE:
+                continue
+            rec.tokens = self.engine.pop_result(rid)
+            rec.state = FINISHED
+            rec.finish_t = self._clock()
+            if rec.deadline is not None and rec.finish_t > rec.deadline:
+                rec.deadline_missed = True
+                if self._metrics is not None:
+                    self._metrics["deadline_miss"].inc()
+            if self._metrics is not None:
+                self._metrics["completed"].inc()
+            self._event(events, rec,
+                        {"type": "finished", "rid": rid,
+                         "tokens": list(rec.tokens),
+                         "deadline_missed": rec.deadline_missed})

@@ -1,0 +1,281 @@
+"""Streaming HTTP frontend over a ``Scheduler`` or ``ReplicaRouter``.
+
+Stdlib-only (``http.server``), mirroring
+``observability.exposition.MetricsServer``'s dependency discipline.
+Three endpoints:
+
+* ``POST /v1/completions`` — JSON body
+  ``{"prompt": [token ids], "max_tokens": N, "stream": true,
+  "eos_token_id": ..., "priority": ..., "deadline": ...,
+  "max_queue_time": ..., "id": ...}``.  With ``stream`` (the
+  default) the response is chunked ``application/x-ndjson``: one
+  ``{"id", "tokens": [...]}`` line per engine step window as tokens
+  are produced, then a terminal ``{"id", "done": true, "state",
+  "n_tokens", "deadline_missed"}`` line.  ``"stream": false``
+  returns one JSON object with the full token list.  Overload maps to
+  HTTP: a shed request is ``429``, an invalid one ``400``.
+* ``GET /healthz`` — liveness + queue/replica summary.
+* ``GET /metrics`` — Prometheus text via the observability
+  registry's ``expose_text`` (same format the standalone
+  ``start_metrics_server`` serves).
+
+The frontend owns the scheduling loop: a daemon thread drives
+``target.step()`` whenever work is pending, so handler threads only
+submit and wait on their per-request event queues — all engine work
+stays on ONE thread, as the scheduler's contract requires.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..common.errors import EnforceError
+from ..observability import get_registry
+from ..observability.exposition import CONTENT_TYPE as _PROM_CONTENT_TYPE
+from .scheduler import RejectedError
+
+__all__ = ["HTTPFrontend", "start_http_frontend"]
+
+_TERMINAL = ("finished", "cancelled", "shed")
+
+
+class HTTPFrontend:
+    """Serving endpoint handle: ``.port`` / ``.url``, ``.shutdown()``.
+    ``target`` is anything with the scheduler request surface
+    (``submit/cancel/pop_result/step/busy/metrics_snapshot``) — a
+    ``Scheduler`` or a ``ReplicaRouter``."""
+
+    def __init__(self, target, addr: str = "127.0.0.1", port: int = 0,
+                 registry=None, default_max_tokens: int = 64,
+                 request_timeout: float = 120.0,
+                 poll_interval: float = 0.002):
+        self.target = target
+        self.registry = registry or get_registry()
+        self.default_max_tokens = default_max_tokens
+        self.request_timeout = request_timeout
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):        # keep request logs quiet
+                pass
+
+            def _json(self, code: int, obj: dict):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    self._json(200, frontend._health())
+                elif path == "/metrics":
+                    body = frontend.registry.expose_text().encode(
+                        "utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     _PROM_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": f"no route {path}"})
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                if path != "/v1/completions":
+                    self._json(404, {"error": f"no route {path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad JSON body: {e}"})
+                    return
+                frontend._completions(self, body)
+
+        self._httpd = ThreadingHTTPServer((addr, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = addr
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="paddle-tpu-serving-http", daemon=True)
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-serving-sched",
+            daemon=True)
+        self._http_thread.start()
+        self._loop_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.addr}:{self.port}"
+
+    # -- the scheduling loop ---------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            if self.target.busy():
+                self.target.step()
+            else:
+                self._stop.wait(self.poll_interval)
+
+    def shutdown(self, drain: bool = True):
+        """Stop serving.  ``drain=True`` finishes in-flight requests
+        first (new submissions are already refused once the HTTP
+        socket closes)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=10)
+        self._stop.set()
+        self._loop_thread.join(timeout=10)
+        if drain:
+            self.target.drain()
+
+    # -- handlers --------------------------------------------------------------
+    def _health(self) -> dict:
+        snap = self.target.metrics_snapshot()
+        out = {"status": "ok"}
+        if "replicas" in snap:                # router target
+            out["replicas"] = [
+                {"replica": r["replica"], "healthy": r["healthy"],
+                 "load": r["load"]} for r in snap["replicas"]]
+        else:
+            out["waiting"] = snap.get("waiting", 0)
+            out["draining"] = snap.get("draining", False)
+        return out
+
+    def _completions(self, handler, body: dict):
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or \
+                not all(isinstance(t, int) for t in prompt):
+            handler._json(400, {"error": "'prompt' must be a list of "
+                                         "token ids"})
+            return
+        rid = body.get("id") or uuid.uuid4().hex
+        stream = bool(body.get("stream", True))
+        events: "queue.Queue[dict]" = queue.Queue()
+        kw = dict(max_new_tokens=int(body.get("max_tokens",
+                                              self.default_max_tokens)),
+                  priority=int(body.get("priority", 0)),
+                  on_event=events.put)
+        if body.get("eos_token_id") is not None:
+            kw["eos_token_id"] = int(body["eos_token_id"])
+        if body.get("deadline") is not None:
+            kw["deadline"] = float(body["deadline"])
+        if body.get("max_queue_time") is not None:
+            kw["max_queue_time"] = float(body["max_queue_time"])
+        try:
+            self.target.submit(rid, prompt, **kw)
+        except RejectedError as e:
+            handler._json(429, {"error": str(e), "id": rid})
+            return
+        except EnforceError as e:
+            handler._json(400, {"error": str(e), "id": rid})
+            return
+        try:
+            if stream:
+                self._stream_response(handler, rid, events)
+            else:
+                self._unary_response(handler, rid, events)
+        finally:
+            self._forget(rid)
+
+    def _forget(self, rid):
+        """Best-effort teardown after the response (or a client
+        disconnect): cancel if still running, then drop the record so
+        a long-lived server's memory stays bounded."""
+        try:
+            if self.target.status(rid) in ("waiting", "active"):
+                self.target.cancel(rid)
+                # an active-request cancel lands at the loop thread's
+                # next step(); wait it out before popping
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and \
+                        self.target.status(rid) in ("waiting",
+                                                    "active"):
+                    time.sleep(self.poll_interval)
+            self.target.forget(rid)
+        except Exception:
+            pass                              # already popped
+
+    def _next_event(self, events) -> Optional[dict]:
+        try:
+            return events.get(timeout=self.request_timeout)
+        except queue.Empty:
+            return None
+
+    def _stream_response(self, handler, rid, events):
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def chunk(obj: dict):
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            handler.wfile.write(hex(len(data))[2:].encode("ascii") +
+                                b"\r\n" + data + b"\r\n")
+            handler.wfile.flush()
+
+        n_tokens = 0
+        while True:
+            ev = self._next_event(events)
+            if ev is None:
+                chunk({"id": rid, "done": True, "state": "timeout",
+                       "n_tokens": n_tokens})
+                break
+            if ev["type"] == "tokens":
+                n_tokens += len(ev["tokens"])
+                chunk({"id": rid, "tokens": ev["tokens"]})
+            elif ev["type"] in _TERMINAL:
+                chunk({"id": rid, "done": True, "state": ev["type"],
+                       "n_tokens": len(ev.get("tokens", [])) or
+                       n_tokens,
+                       "deadline_missed": ev.get("deadline_missed",
+                                                 False),
+                       "reason": ev.get("reason")})
+                break
+        handler.wfile.write(b"0\r\n\r\n")
+        handler.wfile.flush()
+
+    def _unary_response(self, handler, rid, events):
+        tokens = []
+        while True:
+            ev = self._next_event(events)
+            if ev is None:
+                handler._json(504, {"error": "generation timed out",
+                                    "id": rid,
+                                    "tokens": tokens})
+                return
+            if ev["type"] == "tokens":
+                tokens.extend(ev["tokens"])
+            elif ev["type"] == "shed":
+                handler._json(429, {"error": f"request shed "
+                                             f"({ev.get('reason')})",
+                                    "id": rid})
+                return
+            elif ev["type"] in _TERMINAL:
+                handler._json(200, {
+                    "id": rid, "state": ev["type"],
+                    "tokens": ev.get("tokens") or tokens,
+                    "deadline_missed": ev.get("deadline_missed",
+                                              False)})
+                return
+
+
+def start_http_frontend(target, addr: str = "127.0.0.1",
+                        port: int = 0, **kw) -> HTTPFrontend:
+    """Serve ``target`` (a Scheduler or ReplicaRouter) over HTTP on a
+    daemon thread; ``port=0`` picks an ephemeral port (read it back
+    from the handle)."""
+    return HTTPFrontend(target, addr=addr, port=port, **kw)

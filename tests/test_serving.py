@@ -1,0 +1,469 @@
+"""paddle_tpu.serving — scheduler / router / HTTP frontend.
+
+Contracts under test (ISSUE 4):
+* scheduler equivalence: scheduled tokens bit-identical to driving
+  the engine directly, prefill/decode compile counts unchanged;
+* overload: demand > slot/page capacity queues then sheds with
+  ``RejectedError`` — no ``PagedKVCache`` OOM raise escapes;
+* deadlines / queue timeouts (fake clock — no real waiting);
+* cancellation mid-decode releases pages and leaves co-running
+  requests bit-exact;
+* router failover under injected replica faults;
+* end-to-end HTTP streaming + /metrics scrape (stdlib http.client).
+
+Everything runs JAX_PLATFORMS=cpu and single-threaded engine work —
+the HTTP test's threads only queue and wait.
+"""
+import json
+import http.client
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.common.errors import EnforceError
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (HTTPFrontend, RejectedError,
+                                ReplicaRouter, Scheduler,
+                                start_http_frontend)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return m
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _direct(model, prompt, n, **ekw):
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8, **ekw)
+    eng.add_request("ref", prompt, max_new_tokens=n)
+    while eng.has_work():
+        eng.step()
+    return eng.result("ref")
+
+
+# -- scheduler: equivalence ----------------------------------------------------
+def test_scheduler_matches_direct_engine(model):
+    """Same request stream through the scheduler == direct engine,
+    bit-identical; and scheduling compiles NOTHING new (the single
+    chunked-prefill program survives)."""
+    from paddle_tpu.inference import engine as E
+    streams = {"a": ([5, 9, 2, 14], 8), "b": ([3, 3, 7], 5),
+               "c": (list(range(1, 12)), 4)}
+    want = {rid: _direct(model, p, n) for rid, (p, n) in streams.items()}
+
+    pre_c = E._paged_prefill_chunk._cache_size()
+    dec_c = E._paged_decode_step._cache_size()
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    sched = Scheduler(eng, max_queue=8)
+    for rid, (p, n) in streams.items():
+        sched.submit(rid, p, max_new_tokens=n)
+    out = sched.run_until_idle()
+    for rid in streams:
+        assert sched.result(rid) == want[rid]
+        assert out[rid] == want[rid]          # streamed == final
+    assert E._paged_prefill_chunk._cache_size() == pre_c, \
+        "scheduling recompiled prefill"
+    assert E._paged_decode_step._cache_size() == dec_c, \
+        "scheduling recompiled decode"
+    snap = sched.metrics_snapshot()
+    assert snap["admitted"] == 3 and snap["completed"] == 3
+    assert snap["engine"]["kv_cache"]["oom_events"] == 0
+
+
+def test_scheduler_priority_order(model):
+    """With one slot, waiting requests admit in (priority, FIFO)
+    order, not submission order."""
+    eng = LLMEngine(model, max_seqs=1, max_len=32, page_size=8,
+                    n_pages=3, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=8)
+    admitted = []
+
+    def watcher(rid):
+        def cb(ev):
+            if ev["type"] == "tokens" and rid not in admitted:
+                admitted.append(rid)
+        return cb
+
+    sched.submit("hold", [1, 2, 3], max_new_tokens=4,
+                 on_event=watcher("hold"))
+    sched.step()                              # occupies the only slot
+    for rid, prio in [("p2", 2), ("p0", 0), ("p1", 1)]:
+        sched.submit(rid, [4, 5, 6], max_new_tokens=2, priority=prio,
+                     on_event=watcher(rid))
+    sched.run_until_idle()
+    assert admitted == ["hold", "p0", "p1", "p2"]
+
+
+# -- scheduler: overload / deadlines -------------------------------------------
+def test_overload_queues_then_sheds_without_oom(model):
+    """Demand > slot+page capacity: the bounded queue absorbs, the
+    overflow sheds with RejectedError, and the cache's OOM counter
+    stays at zero — the raise never happens, let alone escapes."""
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    n_pages=5, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=2)
+    shed = 0
+    for i in range(6):                        # capacity is 2 concurrent
+        try:
+            sched.submit(f"r{i}", [1 + i, 2, 3], max_new_tokens=8)
+        except RejectedError:
+            shed += 1
+    assert shed == 4                          # 2 queued, 4 shed
+    sched.run_until_idle()
+    for i in range(2):
+        assert len(sched.result(f"r{i}")) == 8
+    snap = sched.metrics_snapshot()
+    assert snap["shed"]["queue_full"] == 4
+    assert snap["engine"]["kv_cache"]["oom_events"] == 0
+    # the counters are scrapeable in Prometheus text
+    text = paddle.observability.get_registry().expose_text()
+    assert "serving_sched_shed_total" in text
+    assert 'reason="queue_full"' in text
+    assert "serving_sched_deadline_miss_total" in text
+    assert "serving_sched_queue_wait_seconds_bucket" in text
+
+
+def test_overload_queue_absorbs_within_bound(model):
+    """Inside the queue bound nothing sheds: everything completes as
+    slots/pages free up, with zero OOM events."""
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    n_pages=5, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=8)
+    for i in range(6):
+        sched.submit(f"q{i}", [1 + i, 2, 3], max_new_tokens=6)
+    sched.run_until_idle()
+    for i in range(6):
+        assert len(sched.result(f"q{i}")) == 6
+    assert sched.shed_stats == {}
+    assert eng.cache.metrics_snapshot()["oom_events"] == 0
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+
+
+def test_queue_timeout_sheds_waiting_request(model):
+    clock = FakeClock()
+    eng = LLMEngine(model, max_seqs=1, max_len=32, page_size=8,
+                    n_pages=3, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=4, max_queue_time=2.0,
+                      clock=clock)
+    sched.submit("hold", [1, 2, 3], max_new_tokens=8)
+    sched.step()                              # hold takes the slot
+    sched.submit("late", [4, 5, 6], max_new_tokens=4)
+    clock.advance(3.0)                        # past max_queue_time
+    sched.step()
+    assert sched.status("late") == "shed"
+    with pytest.raises(RejectedError):
+        sched.result("late")
+    assert sched.shed_stats["queue_timeout"] == 1
+    sched.run_until_idle()
+    assert len(sched.result("hold")) == 8
+
+
+def test_deadline_miss_accounting(model):
+    clock = FakeClock()
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    n_pages=5, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=4, clock=clock)
+    # finishes, but after its deadline: delivered + counted as a miss
+    sched.submit("late", [1, 2, 3], max_new_tokens=4, deadline=5.0)
+    sched.step()
+    clock.advance(10.0)
+    sched.run_until_idle()
+    assert len(sched.result("late")) == 4
+    assert sched._reqs["late"].deadline_missed
+    # still waiting past its deadline: shed, counted as a miss too
+    eng2 = LLMEngine(model, max_seqs=1, max_len=32, page_size=8,
+                     n_pages=3, enable_prefix_caching=False)
+    sched2 = Scheduler(eng2, max_queue=4, clock=clock)
+    sched2.submit("hold", [1, 2, 3], max_new_tokens=8)
+    sched2.step()
+    sched2.submit("doomed", [4, 5, 6], max_new_tokens=4, deadline=1.0)
+    clock.advance(2.0)
+    sched2.step()
+    assert sched2.status("doomed") == "shed"
+    assert sched2.shed_stats["deadline"] == 1
+    snap = sched2.metrics_snapshot()
+    assert snap["deadline_miss"] >= 1
+
+
+# -- scheduler: cancellation / drain / memory ----------------------------------
+def test_cancel_mid_decode_releases_pages_keeps_others_exact(model):
+    want_b = _direct(model, [3, 3, 7], 8)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    sched = Scheduler(eng, max_queue=4)
+    sched.submit("dead", [5, 9, 2, 14], max_new_tokens=32)
+    sched.submit("b", [3, 3, 7], max_new_tokens=8)
+    sched.step()
+    sched.step()
+    assert sched.cancel("dead") is True
+    sched.run_until_idle()
+    assert sched.status("dead") == "cancelled"
+    part = sched.result("dead")
+    assert 1 <= len(part) < 33                # partial stream, defined
+    assert sched.result("b") == want_b        # co-runner untouched
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+    assert sched.metrics_snapshot()["aborted"] == 1
+    # cancel after retirement is a no-op, not an error
+    assert sched.cancel("b") is False
+
+
+def test_cancel_waiting_request(model):
+    eng = LLMEngine(model, max_seqs=1, max_len=32, page_size=8,
+                    n_pages=3, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=4)
+    sched.submit("hold", [1, 2, 3], max_new_tokens=6)
+    sched.step()
+    sched.submit("queued", [4, 5, 6], max_new_tokens=4)
+    assert sched.cancel("queued") is True
+    assert sched.status("queued") == "cancelled"
+    assert sched.result("queued") == []
+    sched.run_until_idle()
+    assert len(sched.result("hold")) == 6
+
+
+def test_drain_refuses_new_finishes_inflight(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    sched = Scheduler(eng, max_queue=4)
+    sched.submit("a", [5, 9, 2], max_new_tokens=6)
+    sched.step()
+    sched.stop_admission()
+    with pytest.raises(RejectedError):
+        sched.submit("nope", [1, 2], max_new_tokens=2)
+    sched.drain()
+    assert len(sched.result("a")) == 6
+    assert not sched.busy()
+
+
+def test_scheduler_bounds_engine_memory(model):
+    """Retirement pops the engine's request map — a long request
+    stream leaves neither engine nor (after pop_result) scheduler
+    records behind."""
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8)
+    sched = Scheduler(eng, max_queue=8)
+    for i in range(6):
+        sched.submit(f"m{i}", [1 + i, 2], max_new_tokens=3)
+        sched.run_until_idle()
+        assert sched.pop_result(f"m{i}") is not None
+    assert eng.requests == {}                 # pop_result kept it clean
+    assert sched._reqs == {}
+    # rid reuse after pop is allowed
+    sched.submit("m0", [9, 9], max_new_tokens=2)
+    sched.run_until_idle()
+    assert len(sched.pop_result("m0")) == 2
+
+
+# -- engine primitives ---------------------------------------------------------
+def test_engine_abort_primitive(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    eng.add_request("x", [5, 9, 2, 14], max_new_tokens=16)
+    eng.step()
+    free_before = eng.cache.free_pages()
+    assert eng.abort("x") is True
+    assert eng.requests["x"].cancelled and eng.requests["x"].done
+    assert not eng.has_work()
+    assert eng.cache.free_pages() > free_before    # pages released
+    toks = eng.result("x")                    # defined answer: partial
+    assert len(toks) >= 1
+    assert eng.abort("x") is False            # idempotent
+    assert eng.pop_result("x") == toks
+    assert "x" not in eng.requests
+    with pytest.raises(EnforceError):
+        eng.abort("never-admitted")
+
+
+def test_engine_capacity_introspection(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    enable_prefix_caching=False)
+    total = eng.cache.n_pages - 1
+    assert eng.free_slots() == 2
+    assert eng.cache.free_pages() == total
+    eng.add_request("a", [1, 2, 3], max_new_tokens=8)  # 11 tok = 2 pages
+    assert eng.free_slots() == 1
+    assert eng.cache.free_pages() == total - 2
+    eng.add_request("b", [4, 5, 6], max_new_tokens=8)
+    assert eng.free_slots() == 0
+    while eng.has_work():
+        eng.step()
+    assert eng.free_slots() == 2
+    assert eng.cache.free_pages() == total
+
+
+def test_free_pages_counts_evictable_cached_pages(model):
+    """With prefix caching on, a retired prompt's registered pages sit
+    in the LRU pool — still allocatable, and free_pages says so."""
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8,
+                    enable_prefix_caching=True)
+    eng.add_request("a", list(range(1, 18)), max_new_tokens=2)
+    while eng.has_work():
+        eng.step()
+    assert eng.cache.cached_page_count() >= 1       # pages parked in LRU
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+    assert eng.cache.free_pages() == eng.cache.free_page_count()
+
+
+# -- router --------------------------------------------------------------------
+def _mk_replica(model, **kw):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8, **kw)
+    return Scheduler(eng, max_queue=4)
+
+
+def test_router_failover_under_injected_fault(model):
+    want = _direct(model, [5, 9, 2], 4)
+    router = ReplicaRouter([_mk_replica(model), _mk_replica(model)],
+                           failure_threshold=2, sleep=lambda s: None)
+    fails = []
+
+    def boom(rid):
+        fails.append(rid)
+        raise RuntimeError("injected replica fault")
+
+    router.set_fault(0, boom)
+    idxs = [router.submit(f"f{i}", [5, 9, 2], max_new_tokens=4)
+            for i in range(3)]
+    assert all(i == 1 for i in idxs)          # all completed on survivor
+    assert router.retry_count >= 2            # failovers counted
+    assert router.healthy_replicas() == [1]   # circuit opened on 0
+    router.run_until_idle()
+    for i in range(3):
+        assert router.result(f"f{i}") == want # tokens still bit-exact
+    snap = router.metrics_snapshot()
+    assert snap["retries"] == router.retry_count
+    assert snap["replicas"][0]["healthy"] is False
+    assert snap["replicas"][1]["requests_total"] == 3
+    text = paddle.observability.get_registry().expose_text()
+    assert "serving_router_retries_total" in text
+    assert "serving_router_replica_unhealthy" in text
+
+
+def test_router_circuit_recloses_after_cooldown(model):
+    clock = FakeClock()
+    router = ReplicaRouter(
+        [Scheduler(LLMEngine(model, max_seqs=2, max_len=64,
+                             page_size=8), max_queue=4, clock=clock)
+         for _ in range(2)],
+        failure_threshold=1, cooldown=5.0, clock=clock,
+        sleep=lambda s: None)
+    router.set_fault(0, lambda rid: (_ for _ in ()).throw(
+        RuntimeError("down")))
+    router.submit("a", [5, 9, 2], max_new_tokens=2)
+    assert router.healthy_replicas() == [1]
+    router.clear_fault(0)
+    clock.advance(6.0)                        # past cooldown: half-open
+    assert 0 in router.healthy_replicas()
+    # replica 1 is loaded, 0 is idle -> least-loaded probe hits 0
+    idx = router.submit("b", [5, 9, 2], max_new_tokens=2)
+    assert idx == 0
+    assert router.healthy_replicas() == [0, 1]
+    router.run_until_idle()
+    assert len(router.pop_result("a")) == 2
+    assert len(router.pop_result("b")) == 2
+
+
+def test_router_least_loaded_and_all_reject(model):
+    router = ReplicaRouter(
+        [Scheduler(LLMEngine(model, max_seqs=1, max_len=32,
+                             page_size=8, n_pages=3,
+                             enable_prefix_caching=False),
+                   max_queue=1) for _ in range(2)],
+        sleep=lambda s: None)
+    # wave 1 spreads across the replicas, step() makes them active,
+    # wave 2 fills both bounded queues: 1 active + 1 waiting each
+    spread = [router.submit(f"l{i}", [1 + i, 2, 3], max_new_tokens=4)
+              for i in range(2)]
+    router.step()
+    spread += [router.submit(f"l{i}", [1 + i, 2, 3], max_new_tokens=4)
+               for i in range(2, 4)]
+    assert sorted(spread[:2]) == [0, 1]       # least-loaded spreads
+    assert sorted(spread[2:]) == [0, 1]
+    with pytest.raises(RejectedError):        # everyone full -> shed
+        router.submit("overflow", [9, 9], max_new_tokens=2)
+    router.run_until_idle()
+    for i in range(4):
+        assert len(router.result(f"l{i}")) == 4
+
+
+# -- HTTP frontend -------------------------------------------------------------
+@pytest.fixture()
+def frontend(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    fe = start_http_frontend(Scheduler(eng, max_queue=4))
+    yield fe
+    fe.shutdown()
+
+
+def test_http_streams_completion_and_scrapes_metrics(model, frontend):
+    want = _direct(model, [5, 9, 2, 14], 8)
+    conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [5, 9, 2, 14],
+                             "max_tokens": 8, "id": "h1"}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "application/x-ndjson"
+    lines = [json.loads(l) for l in
+             resp.read().decode("utf-8").splitlines()]
+    streamed = [t for l in lines for t in l.get("tokens", [])]
+    assert streamed == want                   # chunked stream, bit-exact
+    assert len(lines) >= 2                    # actually incremental
+    assert lines[-1]["done"] and lines[-1]["state"] == "finished"
+    assert lines[-1]["n_tokens"] == 8
+    conn.close()
+
+    hz = json.loads(urllib.request.urlopen(
+        frontend.url + "/healthz", timeout=30).read())
+    assert hz["status"] == "ok"
+    text = urllib.request.urlopen(
+        frontend.url + "/metrics", timeout=30).read().decode("utf-8")
+    assert "serving_sched_admitted_total" in text
+    assert "serving_sched_shed_total" in text
+    assert "llm_engine_generated_tokens_total" in text
+
+
+def test_http_unary_and_errors(model, frontend):
+    want = _direct(model, [3, 3, 7], 5)
+    conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [3, 3, 7], "max_tokens": 5,
+                             "stream": False}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = json.loads(resp.read())
+    assert body["state"] == "finished" and body["tokens"] == want
+    # bad prompt -> 400, not a hung request
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": "not ids"}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    # over the model limit -> 400 from the scheduler's submit check
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": list(range(60)),
+                             "max_tokens": 50}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    conn.close()
+    # unknown routes 404
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(frontend.url + "/nope", timeout=30)
