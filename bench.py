@@ -1093,6 +1093,109 @@ def bench_serving_sched():
                   "shed": shed}}
 
 
+def bench_serving_preempt():
+    """Preemptive-scheduling row (ISSUE 5): priority-mixed OVERLOAD —
+    low-priority long decodes saturate every slot, then high-priority
+    short requests arrive.  The PR 4 scheduler (``preemption=False``)
+    parks the high-priority work until a long decode finishes its full
+    token budget; the preemptive scheduler suspends the
+    lowest-priority active request (KV pages swap to the host pool),
+    admits the high-priority request into the freed slot NOW, and
+    resumes the victim afterwards with bit-identical tokens.  Headline
+    value: mean high-priority TTFT (submit → first token).  Goodput
+    (total tokens / wall) is reported too — preemption must not buy
+    latency with meaningful throughput (the swap/replay overhead is
+    the only tax)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        seqs, page, maxlen = 4, 128, 2048
+        n_low, n_high, plen, new_low, new_high = 4, 4, 256, 512, 32
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        seqs, page, maxlen = 2, 8, 32
+        n_low, n_high, plen, new_low, new_high = 2, 2, 4, 24, 4
+        dtype = np.float32
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    lows = [(f"lo{i}", rng.integers(1, cfg.vocab_size, plen).tolist())
+            for i in range(n_low)]
+    highs = [(f"hi{i}", rng.integers(1, cfg.vocab_size, plen).tolist())
+             for i in range(n_high)]
+
+    def run(preempt):
+        eng = LLMEngine(model, max_seqs=seqs, max_len=maxlen,
+                        page_size=page, dtype=dtype,
+                        enable_prefix_caching=False)
+        sched = Scheduler(eng, max_queue=n_low + n_high,
+                          preemption=preempt,
+                          max_preemptions_per_request=4)
+        submit_t, ttft = {}, {}
+
+        def watch(rid):
+            def cb(ev):
+                if ev["type"] == "tokens" and rid not in ttft:
+                    ttft[rid] = time.perf_counter() - submit_t[rid]
+            return cb
+
+        t0 = time.perf_counter()
+        for rid, prompt in lows:
+            submit_t[rid] = time.perf_counter()
+            sched.submit(rid, prompt, max_new_tokens=new_low,
+                         priority=1, on_event=watch(rid))
+        sched.step()                          # longs take every slot
+        for rid, prompt in highs:
+            submit_t[rid] = time.perf_counter()
+            sched.submit(rid, prompt, max_new_tokens=new_high,
+                         priority=0, on_event=watch(rid))
+        sched.run_until_idle()
+        wall = time.perf_counter() - t0
+        tokens = sum(len(rec.tokens) for rec in sched._reqs.values()
+                     if rec.state == "finished")
+        hi_ttft = float(np.mean([ttft[r] for r, _ in highs]))
+        snap = sched.metrics_snapshot()
+        return (hi_ttft, tokens / wall, wall,
+                snap.get("preempted", 0),
+                int(snap["engine"]["kv_cache"]["oom_events"]),
+                snap["engine"]["kv_cache"]["swap_out_pages"])
+
+    run(True)                                 # warmup: compiles
+    base_ttft, base_goodput, base_wall, _, base_oom, _ = run(False)
+    pre_ttft, pre_goodput, pre_wall, n_preempt, pre_oom, swapped = \
+        run(True)
+    return {
+        "metric": "serving_preempt_high_priority_ttft_seconds",
+        "value": round(pre_ttft, 4),
+        "unit": "seconds (mean, high priority)",
+        "vs_baseline": round(base_ttft / pre_ttft, 3) if pre_ttft
+        else None,
+        "extra": {"device_kind": kind, "slots": seqs,
+                  "low_priority_requests": n_low,
+                  "high_priority_requests": n_high,
+                  "max_new_low": new_low, "max_new_high": new_high,
+                  "ttft_no_preemption": round(base_ttft, 4),
+                  "goodput_preempt_tok_per_s": round(pre_goodput, 1),
+                  "goodput_no_preempt_tok_per_s":
+                      round(base_goodput, 1),
+                  "wall_seconds_preempt": round(pre_wall, 4),
+                  "wall_seconds_no_preempt": round(base_wall, 4),
+                  "preemptions": n_preempt,
+                  "swapped_out_pages": swapped,
+                  "oom_events": pre_oom + base_oom}}
+
+
 def jnp_bf16():
     import jax.numpy as jnp
     return jnp.bfloat16
@@ -1208,6 +1311,7 @@ def main():
                ("bench_serving_metrics", bench_serving_metrics),
                ("bench_serving_prefix", bench_serving_prefix),
                ("bench_serving_sched", bench_serving_sched),
+               ("bench_serving_preempt", bench_serving_preempt),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

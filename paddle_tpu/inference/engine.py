@@ -34,6 +34,18 @@ matmul outputs); models already converted by
 knobs keep the no-recompile property: the quantized programs' shapes
 are still fixed by the engine geometry alone.
 
+Preemption (``suspend``/``resume``): a request can be evicted from the
+decode batch mid-generation — its KV pages swap into the paged cache's
+bounded host pool (``swap_pool_pages=``) and its slot frees — and
+later re-admitted.  Resume restores the pages host-side (swap-in) or,
+when the pool could not hold them or the entry was dropped, REPLAYS
+the prompt through the same chunked-prefill program and the
+already-generated tokens through the same compiled decode program —
+either way the request continues with bit-identical tokens to an
+unpreempted run (greedy decoding; the sampling strategy's key stream
+is global per step, so preemption reshuffles it by construction) and
+no new prefill compilations.
+
 Automatic prefix caching (``enable_prefix_caching=``, default on):
 admission looks up the longest cached page-aligned prefix of the
 prompt in the paged cache's chain-hash index, maps those pages into
@@ -79,6 +91,10 @@ class GenRequest:
         self.slot: Optional[int] = None
         self.done = False
         self.cancelled = False
+        # preemption: suspended requests hold no slot or device pages,
+        # only (maybe) a host swap-pool entry
+        self.suspended = False
+        self.swap_handle: Optional[int] = None
 
 
 def _wout(w) -> int:
@@ -374,7 +390,8 @@ class LLMEngine:
                  kv_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  enable_metrics: bool = True,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 swap_pool_pages: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -416,11 +433,17 @@ class LLMEngine:
         if kv_dtype not in (None, "int8"):
             dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                      "float16": jnp.float16}[kv_dtype]
+        # host swap pool for preemption: default as many pages as the
+        # device pool (host DRAM is cheap next to HBM; 0 disables swap
+        # and makes every resume recompute)
+        if swap_pool_pages is None:
+            swap_pool_pages = n_pages
         self.cache = PagedKVCache(
             n_pages=n_pages, page_size=page_size, n_kv_heads=self.kvh,
             head_dim=self.head_dim, max_seqs=max_seqs, max_len=max_len,
             dtype=dtype, num_layers=len(layers),
-            kv_dtype="int8" if kv_dtype == "int8" else None)
+            kv_dtype="int8" if kv_dtype == "int8" else None,
+            swap_pool_pages=swap_pool_pages)
 
         def stackp(get):
             return jnp.stack([get(l).value for l in layers])
@@ -529,8 +552,18 @@ class LLMEngine:
                 "Requests admitted.", lbl).labels(eid),
             "aborted": reg.counter(
                 "llm_engine_aborted_total",
-                "Requests cancelled via abort() before finishing.",
-                lbl).labels(eid),
+                "Requests cancelled via abort() before finishing "
+                "(suspended requests included — their swap entry is "
+                "dropped).", lbl).labels(eid),
+            "suspended": reg.counter(
+                "llm_engine_suspended_total",
+                "Requests preempted out of the decode batch "
+                "(suspend()).", lbl).labels(eid),
+            "resumed": reg.counter(
+                "llm_engine_resumed_total",
+                "Suspended requests re-admitted, by restore path "
+                "(swap_in: host pages copied back; recompute: prompt "
+                "+ generated tokens replayed).", ("engine", "path")),
             "queue_depth": reg.gauge(
                 "llm_engine_queue_depth",
                 "Requests active in the decode batch.", lbl).labels(eid),
@@ -571,6 +604,90 @@ class LLMEngine:
         m = self._metrics
         m["prefill_compiles"].set(self.prefill_compiles())
         m["decode_compiles"].set(self.decode_compiles())
+
+    # -- prefill / replay internals --------------------------------------------
+    def _prefill_seq(self, slot, seq, start_chunk: int):
+        """Run the single compiled chunked-prefill program over
+        ``seq`` in ``slot``, starting at chunk ``start_chunk`` (earlier
+        chunks' pages are already written — the prefix-cache-hit
+        path).  Returns the last real token's logits row.  Shared by
+        admission and the recompute-resume replay: both go through the
+        SAME jit entry, so ``prefill_compiles() == 1`` holds across
+        preemption too."""
+        import jax.numpy as jnp
+
+        P = self.cache.page_size
+        plen = len(seq)
+        table = np.asarray(self.cache.page_table[slot])
+        logits = None
+        for ci in range(start_chunk, -(-plen // P)):
+            base = ci * P
+            chunk = np.zeros(P, np.int32)
+            real = min(P, plen - base)
+            chunk[:real] = np.asarray(seq[base:base + real], np.int32)
+            (logits, self.cache.k_pages, self.cache.v_pages,
+             self.cache.k_scales, self.cache.v_scales) = \
+                _paged_prefill_chunk(
+                    self._stack, self._norm_w, self._head_w,
+                    self._embed_w, self._rope_prefill,
+                    self.cache.k_pages, self.cache.v_pages,
+                    self.cache.k_scales, self.cache.v_scales,
+                    jnp.asarray(chunk),
+                    jnp.asarray(table), jnp.int32(base),
+                    jnp.int32(int(table[ci])),
+                    jnp.int32(min(plen - 1 - base, P - 1)),
+                    eps=self.eps, kvh=self.kvh,
+                    head_dim=self.head_dim,
+                    transpose_head=self._tied)
+        return logits
+
+    def _replay_decode(self, slot, toks):
+        """Recompute-resume tail: re-append the KV of already-generated
+        ``toks`` through the SAME compiled decode program the original
+        run used, ignoring its sampled outputs and never touching the
+        engine's sampling key (an unpreempted run's key stream must
+        stay reproducible).  Greedy replay re-derives the recorded
+        tokens inside multi-step windows (bit-identical logits ⇒ same
+        argmax), so it reuses the power-of-two window programs;
+        sampling replay forces 1-token windows so the RECORDED token —
+        not a fresh draw — feeds every step."""
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.PRNGKey(0)            # unused by greedy
+        pad = self.max_seqs - 1
+        padt = np.zeros((pad,) + self.cache.page_table.shape[1:],
+                        np.int32)
+        i = 0
+        while i < len(toks):
+            nsteps = min(self.steps_per_sync, len(toks) - i)
+            if self.decode_strategy != "greedy_search":
+                nsteps = 1
+            while nsteps & (nsteps - 1):
+                nsteps &= nsteps - 1
+            self.cache.extend(slot, nsteps)
+            tokens = np.array([toks[i]] + [0] * pad, np.int32)
+            lens = np.concatenate([self.cache.seq_lens[[slot]],
+                                   np.zeros(pad, np.int32)])
+            tables = np.concatenate(
+                [self.cache.page_table[[slot]], padt])
+            (_, self.cache.k_pages, self.cache.v_pages,
+             self.cache.k_scales, self.cache.v_scales) = \
+                _paged_decode_step(
+                    self._stack, self._norm_w, self._head_w,
+                    self._embed_w, self._rope, self.cache.k_pages,
+                    self.cache.v_pages, self.cache.k_scales,
+                    self.cache.v_scales, jnp.asarray(tokens),
+                    jnp.asarray(lens, np.int32), jnp.asarray(tables),
+                    jnp.asarray(lens, np.int32), key,
+                    eps=self.eps, kvh=self.kvh,
+                    head_dim=self.head_dim,
+                    transpose_head=self._tied,
+                    strategy=self.decode_strategy,
+                    top_k=self.top_k, top_p=self.top_p,
+                    temperature=self.temperature, n_steps=nsteps)
+            self.cache.advance([slot], nsteps)
+            i += nsteps
 
     # -- admission -------------------------------------------------------------
     def add_request(self, rid, prompt_ids, max_new_tokens: int = 64,
@@ -625,31 +742,10 @@ class LLMEngine:
         # any prompt-length mix (prefill_compiles() == 1), vs the r4
         # power-of-two buckets (one compile per bucket).  Cached-prefix
         # chunks are skipped: their pages are already written.
-        table = np.asarray(self.cache.page_table[req.slot])
-        n_chunks = -(-plen // P)
-        logits = None
         try:
             with RecordEvent("llm_engine.prefill"):
-                for ci in range(cached // P, n_chunks):
-                    base = ci * P
-                    chunk = np.zeros(P, np.int32)
-                    real = min(P, plen - base)
-                    chunk[:real] = np.asarray(
-                        req.prompt[base:base + real], np.int32)
-                    (logits, self.cache.k_pages, self.cache.v_pages,
-                     self.cache.k_scales, self.cache.v_scales) = \
-                        _paged_prefill_chunk(
-                            self._stack, self._norm_w, self._head_w,
-                            self._embed_w, self._rope_prefill,
-                            self.cache.k_pages, self.cache.v_pages,
-                            self.cache.k_scales, self.cache.v_scales,
-                            jnp.asarray(chunk),
-                            jnp.asarray(table), jnp.int32(base),
-                            jnp.int32(int(table[ci])),
-                            jnp.int32(min(plen - 1 - base, P - 1)),
-                            eps=self.eps, kvh=self.kvh,
-                            head_dim=self.head_dim,
-                            transpose_head=self._tied)
+                logits = self._prefill_seq(req.slot, req.prompt,
+                                           cached // P)
                 self.cache.set_len(req.slot, plen)
                 if self.enable_prefix_caching:
                     # publish this prompt's full pages (the just-
@@ -809,13 +905,130 @@ class LLMEngine:
         admits can always decode to its budget)."""
         return self.cache.free_slot_count()
 
+    def capacity(self) -> tuple:
+        """ATOMIC admission snapshot: ``(free_slots, free_pages)`` in
+        one call.  Invariant (the scheduler relies on it): every
+        capacity-mutating engine operation — ``add_request``,
+        ``step``, ``abort``, ``suspend``, ``resume`` — runs under the
+        scheduler's lock on the stepping thread, so a snapshot taken
+        inside that lock stays exact until the admission decision acts
+        on it.  Reading ``free_slots()`` and ``cache.free_pages()``
+        as two separate calls invites drift the moment anything (a
+        preemption, a retirement) frees capacity between them —
+        admission must use this helper."""
+        return self.cache.free_slot_count(), self.cache.free_pages()
+
+    def suspended_count(self) -> int:
+        """Live requests currently preempted out of the decode batch
+        (they hold no slot or device pages)."""
+        return sum(1 for r in self.requests.values()
+                   if r.suspended and not r.done)
+
+    # -- preemption ------------------------------------------------------------
+    def suspend(self, rid) -> bool:
+        """Preempt an ACTIVE request: capture its generated-so-far
+        tokens (they stay on the request record), swap its KV pages
+        into the cache's host pool (or just release them when the pool
+        is full — resume then recomputes), and free its slot.  The
+        freed slot + pages are the point: a higher-priority request
+        can admit into them NOW.  Returns True when the swap path is
+        armed, False when resume will recompute.  Suspended requests
+        still ``result()``-raise like active ones and can be
+        ``abort()``-ed (their swap entry is dropped)."""
+        enforce(rid in self.requests,
+                f"unknown request id {rid!r} (never admitted to this "
+                f"engine)")
+        req = self.requests[rid]
+        enforce(not req.done, f"request {rid!r} already retired")
+        enforce(not req.suspended, f"request {rid!r} already suspended")
+        self._active.remove(req)
+        req.swap_handle = self.cache.swap_out(req.slot)
+        req.slot = None
+        req.suspended = True
+        if self._metrics is not None:
+            self._metrics["suspended"].inc()
+            self._metrics["queue_depth"].set(len(self._active))
+        return req.swap_handle is not None
+
+    def resume(self, rid) -> str:
+        """Re-admit a suspended request; it rejoins the decode batch
+        at the next ``step()`` with tokens bit-identical to a run that
+        was never preempted (greedy decoding — see the class
+        docstring).  Returns the restore path taken: ``"swap_in"``
+        (host pages copied back, no recompute) or ``"recompute"``
+        (prompt replayed through the chunked-prefill program, the
+        generated tokens through the compiled decode program — no new
+        prefill compiles either way).  The caller must ensure capacity
+        first (``capacity()``): the full page budget is re-reserved,
+        exactly like admission."""
+        enforce(rid in self.requests,
+                f"unknown request id {rid!r} (never admitted to this "
+                f"engine)")
+        req = self.requests[rid]
+        enforce(req.suspended and not req.done,
+                f"request {rid!r} is not suspended")
+        plen = len(req.prompt)
+        total = plen + req.max_new
+        path = None
+        if req.swap_handle is not None:
+            slot = self.cache.swap_in(req.swap_handle, total)
+            req.swap_handle = None             # consumed either way
+            if slot is not None:
+                # KV restored byte-exact; length = prompt + generated
+                # so far MINUS the last token (it is the next decode
+                # input — its KV is appended by the next step)
+                self.cache.set_len(slot, plen + len(req.out) - 1)
+                path = "swap_in"
+        if path is None:
+            with RecordEvent("llm_engine.resume_recompute"):
+                slot = self._recompute_resume(req)
+            path = "recompute"
+        req.slot = slot
+        req.suspended = False
+        self._active.append(req)
+        if self._metrics is not None:
+            self._metrics["resumed"].labels(self.engine_id, path).inc()
+            self._metrics["queue_depth"].set(len(self._active))
+        return path
+
+    def _recompute_resume(self, req):
+        """Swapless resume: re-derive the suspended request's KV from
+        its token history — the prompt through the SAME chunked
+        prefill (prefix-cache hits still apply: the prompt's pages
+        often still sit in the LRU pool), the generated tokens through
+        the SAME decode program (``_replay_decode``).  Bit-identical
+        state by construction: same programs, same inputs."""
+        plen = len(req.prompt)
+        P = self.cache.page_size
+        cached, shared_pages = 0, []
+        if self.enable_prefix_caching:
+            cacheable = ((plen - 1) // P) * P
+            cached, shared_pages = self.cache.lookup_prefix(
+                req.prompt[:cacheable])
+        slot = self.cache.allocate(plen + req.max_new,
+                                   shared_pages=shared_pages)
+        try:
+            self._prefill_seq(slot, req.prompt, cached // P)
+            self.cache.set_len(slot, plen)
+            if self.enable_prefix_caching:
+                self.cache.register_prefix(slot, req.prompt,
+                                           upto=(plen // P) * P)
+            self._replay_decode(slot, req.out[:-1])
+        except BaseException:
+            self.cache.release(slot)
+            raise
+        return slot
+
     def abort(self, rid) -> bool:
         """Cancel a request: release its KV pages and retire it with
         ``cancelled=True`` so ``result()`` has a defined answer (the
-        tokens produced before the abort).  Returns True if the
-        request was live and is now cancelled, False if it had already
-        retired (idempotent — a race between natural completion and a
-        client disconnect is not an error).  Unknown rids raise."""
+        tokens produced before the abort).  SUSPENDED requests cancel
+        too — their host swap-pool entry is dropped (they hold no
+        device pages), so an aborted preemptee cannot pin swap space.
+        Returns True if the request was live and is now cancelled,
+        False if it had already retired (idempotent — a race between
+        natural completion and a client disconnect is not an error).
+        Unknown rids raise."""
         enforce(rid in self.requests,
                 f"unknown request id {rid!r} (never admitted to this "
                 f"engine)")
@@ -824,7 +1037,11 @@ class LLMEngine:
             return False
         req.done = True
         req.cancelled = True
-        if req in self._active:
+        if req.suspended:
+            self.cache.drop_swap(req.swap_handle)
+            req.swap_handle = None
+            req.suspended = False
+        elif req in self._active:
             self._active.remove(req)
             self.cache.release(req.slot)
         if self._metrics is not None:
@@ -899,6 +1116,7 @@ class LLMEngine:
             "kv_cache": self.cache.metrics_snapshot(),
             "kv_page_utilization": self.cache.page_utilization(),
             "active_requests": len(self._active),
+            "suspended_requests": self.suspended_count(),
             "free_slots": self.free_slots(),
             "prefix_caching": dict(
                 self.prefix_stats,

@@ -32,6 +32,21 @@ fresh page and device-copies the row — scales included — before any
 mutation), so shared content is immutable by construction.  The int8
 scale pools are indexed by the same physical page ids, so quantized
 serving shares scales with their pages for free.
+
+KV swap (preemptive scheduling, vLLM-style): ``swap_out(slot)`` copies
+the slot's PRIVATE written pages (and int8 scale rows) into a bounded
+host-side swap pool and releases every device page — prefix-cache
+pages the slot maps read-shared are NOT copied, only unpinned, and
+recorded by their chain key so ``swap_in`` can re-pin them (registered
+pages are immutable, so the key still names the same bytes).
+``swap_in(handle, n_tokens)`` restores the sequence into a fresh slot
+with its full ``n_tokens`` page budget re-reserved.  Both degrade
+gracefully: a full pool makes ``swap_out`` release-only (returns
+``None``), and an evicted shared page makes ``swap_in`` fail cleanly
+(returns ``None``) — in either case the caller recomputes the KV from
+the token history instead.  The pool is host DRAM, deliberately
+outside the device HBM budget: preemption trades host memory + PCIe
+copies for freed device pages.
 """
 from __future__ import annotations
 
@@ -59,11 +74,31 @@ def _chain_hash(prev: bytes, tokens) -> bytes:
     return h.digest()
 
 
+class _SwapEntry:
+    """Host-side record of one swapped-out sequence: per written page
+    either ("data", j) — row j of the host arrays holds a private
+    page's bytes — or ("key", chain_key) — a shared prefix page to
+    re-pin through the index at swap-in time."""
+
+    __slots__ = ("plan", "k_host", "v_host", "k_scale_host",
+                 "v_scale_host", "n_host_pages")
+
+    def __init__(self, plan, k_host, v_host, k_scale_host,
+                 v_scale_host):
+        self.plan = plan
+        self.k_host = k_host
+        self.v_host = v_host
+        self.k_scale_host = k_scale_host
+        self.v_scale_host = v_scale_host
+        self.n_host_pages = 0 if k_host is None else k_host.shape[2]
+
+
 class PagedKVCache:
     def __init__(self, n_pages: int, page_size: int, n_kv_heads: int,
                  head_dim: int, max_seqs: int, max_len: int,
                  dtype=np.float32, num_layers: int = 1,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 swap_pool_pages: int = 0):
         import jax.numpy as jnp
         enforce(kv_dtype in (None, "int8"),
                 f"unsupported kv_dtype {kv_dtype!r} (None or 'int8')")
@@ -100,6 +135,12 @@ class PagedKVCache:
         self._index: Dict[bytes, int] = {}       # chain key -> page
         self._page_key: Dict[int, bytes] = {}    # page -> chain key
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # swap state: bounded host pool of page copies for preempted
+        # sequences (0 pages = swap disabled, recompute-only fallback)
+        self.swap_pool_pages = int(swap_pool_pages)
+        self._swap: Dict[int, _SwapEntry] = {}
+        self._swap_used = 0
+        self._swap_ids = itertools.count()
         # page-pressure telemetry (host-side counters — negligible next
         # to the device work these methods bracket); one label set per
         # cache instance so concurrent engines don't blur each other
@@ -135,6 +176,25 @@ class PagedKVCache:
             "kv_cache_prefix_cached_pages",
             "Registered prefix pages currently unreferenced (the LRU "
             "pool).", lbl).labels(self.cache_id)
+        self._m_swap_out = reg.counter(
+            "kv_cache_swap_out_pages_total",
+            "Device pages copied to the host swap pool by swap_out "
+            "(shared prefix pages are unpinned, not copied).",
+            lbl).labels(self.cache_id)
+        self._m_swap_in = reg.counter(
+            "kv_cache_swap_in_pages_total",
+            "Host pages copied back to device pages by swap_in.",
+            lbl).labels(self.cache_id)
+        self._m_swap_fallback = reg.counter(
+            "kv_cache_swap_fallback_total",
+            "swap_out/swap_in attempts that degraded to the recompute "
+            "path (pool full or disabled, entry dropped, or a shared "
+            "prefix page evicted while suspended).", lbl).labels(
+                self.cache_id)
+        self._m_swap_pool = reg.gauge(
+            "kv_cache_swap_pool_pages",
+            "Host swap-pool pages currently holding preempted KV.",
+            lbl).labels(self.cache_id)
 
     def page_utilization(self) -> float:
         """Referenced fraction of the usable pool (excludes pad page 0
@@ -292,6 +352,166 @@ class PagedKVCache:
         self._table[slot, :] = 0
         self._track_pages()
 
+    # -- KV swap (preemption) --------------------------------------------------
+    def swap_out(self, slot: int) -> Optional[int]:
+        """Preempt ``slot``: copy its private WRITTEN pages (and int8
+        scale rows) into the host swap pool, then release every device
+        page the slot holds — the freed pages are what preemption buys.
+        Shared prefix pages are not copied, only unpinned; their chain
+        keys are recorded so ``swap_in`` can re-pin them (registered
+        pages are immutable, so a key that still resolves names the
+        same bytes).
+
+        Returns a swap handle for ``swap_in``, or ``None`` when the
+        bounded pool cannot hold the private pages (or swap is
+        disabled) — the slot is released either way, and the caller
+        falls back to recomputing the KV from the token history."""
+        import jax
+
+        P = self.page_size
+        written = -(-int(self._lens[slot]) // P)
+        pages = self._pages[slot]
+        plan: List[tuple] = []
+        data_pages: List[int] = []
+        for i in range(written):
+            pg = pages[i]
+            if pg in self._page_key:
+                plan.append(("key", self._page_key[pg]))
+            else:
+                plan.append(("data", len(data_pages)))
+                data_pages.append(pg)
+        handle = None
+        if self.swap_pool_pages and \
+                self._swap_used + len(data_pages) <= self.swap_pool_pages:
+            k_host = v_host = ks_host = vs_host = None
+            if data_pages:
+                sel = np.asarray(data_pages)
+                # device_get materializes host copies BEFORE the pages
+                # return to the free list and get overwritten
+                k_host = np.asarray(jax.device_get(
+                    self.k_pages[:, :, sel]))
+                v_host = np.asarray(jax.device_get(
+                    self.v_pages[:, :, sel]))
+                if self.kv_dtype == "int8":
+                    ks_host = np.asarray(jax.device_get(
+                        self.k_scales[:, :, sel]))
+                    vs_host = np.asarray(jax.device_get(
+                        self.v_scales[:, :, sel]))
+            handle = next(self._swap_ids)
+            self._swap[handle] = _SwapEntry(plan, k_host, v_host,
+                                            ks_host, vs_host)
+            self._swap_used += len(data_pages)
+            self._m_swap_out.inc(len(data_pages))
+            self._m_swap_pool.set(self._swap_used)
+        else:
+            self._m_swap_fallback.inc()
+        self.release(slot)
+        return handle
+
+    def swap_in(self, handle: int, n_tokens: int) -> Optional[int]:
+        """Restore a swapped-out sequence into a fresh slot with its
+        full ``n_tokens`` page budget re-reserved (shared prefix pages
+        re-pinned through the index, private pages device-written from
+        the host pool, the unwritten remainder freshly grabbed).
+
+        Returns the new slot id, or ``None`` when the entry cannot be
+        restored (dropped, a shared prefix page was evicted while
+        suspended, or the free/evictable pools cannot cover the
+        budget).  The handle is CONSUMED either way — on ``None`` the
+        caller must recompute, not retry."""
+        import jax.numpy as jnp
+
+        entry = self._swap.pop(handle, None)
+        if entry is None:
+            self._m_swap_fallback.inc()
+            return None
+
+        def _drop(n_shared_pinned=0, shared=()):
+            for pg in list(shared)[:n_shared_pinned][::-1]:
+                self._unref(pg)
+            self._swap_used -= entry.n_host_pages
+            self._m_swap_pool.set(self._swap_used)
+            self._m_swap_fallback.inc()
+            self._track_pages()
+            return None
+
+        # resolve the shared chain keys first (pure reads): any miss
+        # means the prefix page was evicted while we were suspended
+        shared: List[int] = []
+        for kind, val in entry.plan:
+            if kind == "key":
+                pg = self._index.get(val)
+                if pg is None:
+                    return _drop()
+                shared.append(pg)
+        free_slots = [i for i, u in enumerate(self._used) if not u]
+        if not free_slots:
+            return _drop()
+        slot = free_slots[0]
+        need = -(-n_tokens // self.page_size)
+        enforce(need >= len(entry.plan),
+                f"swap_in budget {need} pages < {len(entry.plan)} "
+                f"written pages")
+        # pin shared pages FIRST (mirrors allocate: grabbing the
+        # remainder can then never evict them out from under us)
+        for pg in shared:
+            self._ref[pg] += 1
+            if pg in self._lru:
+                del self._lru[pg]
+        if len(self._free) + len(self._lru) < need - len(shared):
+            return _drop(len(shared), shared)
+        self._m_alloc.inc(len(shared))
+        sit = iter(shared)
+        pages: List[int] = []
+        restore: List[tuple] = []              # (device page, host row)
+        for kind, val in entry.plan:
+            if kind == "key":
+                pages.append(next(sit))
+            else:
+                pg = self._grab_page("swap-in")
+                pages.append(pg)
+                restore.append((pg, val))
+        pages += [self._grab_page("swap-in")
+                  for _ in range(need - len(entry.plan))]
+        if restore:
+            sel = np.asarray([pg for pg, _ in restore])
+            src = np.asarray([j for _, j in restore])
+            self.k_pages = self.k_pages.at[:, :, sel].set(
+                jnp.asarray(entry.k_host[:, :, src]))
+            self.v_pages = self.v_pages.at[:, :, sel].set(
+                jnp.asarray(entry.v_host[:, :, src]))
+            if self.kv_dtype == "int8":
+                self.k_scales = self.k_scales.at[:, :, sel].set(
+                    jnp.asarray(entry.k_scale_host[:, :, src]))
+                self.v_scales = self.v_scales.at[:, :, sel].set(
+                    jnp.asarray(entry.v_scale_host[:, :, src]))
+        self._used[slot] = True
+        self._pages[slot] = pages
+        self._lens[slot] = 0                   # caller set_len()s
+        self._table[slot, :] = 0
+        self._table[slot, :need] = pages
+        self._swap_used -= entry.n_host_pages
+        self._m_swap_in.inc(len(restore))
+        self._m_swap_pool.set(self._swap_used)
+        self._track_pages()
+        return slot
+
+    def drop_swap(self, handle: Optional[int]) -> bool:
+        """Free a swap entry without restoring it (the abort path for
+        suspended requests).  ``None`` and already-consumed handles
+        are no-ops — abort stays idempotent."""
+        entry = self._swap.pop(handle, None) if handle is not None \
+            else None
+        if entry is None:
+            return False
+        self._swap_used -= entry.n_host_pages
+        self._m_swap_pool.set(self._swap_used)
+        return True
+
+    def swap_pool_used(self) -> int:
+        """Host swap-pool pages currently holding preempted KV."""
+        return self._swap_used
+
     # -- prefix caching (public) -----------------------------------------------
     def lookup_prefix(self, token_ids) -> Tuple[int, List[int]]:
         """Longest page-aligned cached prefix of ``token_ids``: walks
@@ -403,7 +623,12 @@ class PagedKVCache:
                 "prefix_cached_pages": self.cached_page_count(),
                 "prefix_shared_pages": self.shared_page_count(),
                 "prefix_evicted_pages": int(self._m_evict.value),
-                "cow_pages": int(self._m_cow.value)}
+                "cow_pages": int(self._m_cow.value),
+                "swap_pool_pages": self.swap_pool_pages,
+                "swap_pool_used": self._swap_used,
+                "swap_out_pages": int(self._m_swap_out.value),
+                "swap_in_pages": int(self._m_swap_in.value),
+                "swap_fallbacks": int(self._m_swap_fallback.value)}
 
     # -- device-side ops -------------------------------------------------------
     def _norm_layers(self, k, v, tokens_axis: int):

@@ -5,7 +5,11 @@ Three layers, composable bottom-up:
 
 * ``Scheduler`` — continuous-batching loop over ONE engine: bounded
   priority queue, capacity-checked admission (a full KV cache queues
-  instead of raising), deadlines / max-queue-time with deadline-miss
+  instead of raising), priority preemption (a strictly-higher-priority
+  waiter evicts the lowest-priority active request; its KV swaps to
+  the host pool or recomputes at resume, tokens stay bit-identical),
+  opt-in bin-packing admission around a blocked head with an aging
+  starvation bound, deadlines / max-queue-time with deadline-miss
   accounting, load shedding (``RejectedError``), cancellation, and
   graceful drain.  Adds policy, never math: tokens are bit-identical
   to driving the engine directly and ``prefill_compiles() == 1``

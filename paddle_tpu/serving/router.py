@@ -98,8 +98,9 @@ class ReplicaRouter:
             "traffic), else 0.", ("router", "replica"))
         self._m_load = reg.gauge(
             "serving_router_replica_load",
-            "Waiting + active requests on the replica (the "
-            "least-loaded routing key).", ("router", "replica"))
+            "Waiting + suspended (preempted) + active requests on the "
+            "replica (the least-loaded routing key).",
+            ("router", "replica"))
         self._metrics = True
 
     def _track_replica(self, idx: int):
@@ -121,8 +122,15 @@ class ReplicaRouter:
                     if self._healthy(i)]
 
     def _load(self, idx: int) -> int:
+        """Waiting + suspended + active on the replica.  Suspended
+        (preempted) requests count: they hold no device pages right
+        now, but they WILL resume and reclaim capacity — a replica
+        thrashing on preemption must look loaded to the router, or
+        least-loaded routing feeds the thrash.  Ties still break on
+        replica index (deterministic)."""
         sched = self.replicas[idx]
-        return sched._n_waiting + len(sched.engine._active)
+        return (sched._n_waiting + sched._n_suspended +
+                len(sched.engine._active))
 
     def _pick(self, exclude) -> Optional[int]:
         cands = [i for i in range(len(self.replicas))

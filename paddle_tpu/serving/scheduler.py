@@ -26,7 +26,22 @@ The ``Scheduler`` wraps ONE engine with:
   as a deadline miss — the accounting a goodput bench needs;
 * cancellation (``cancel``) for waiting AND active requests — active
   ones release their KV pages via ``LLMEngine.abort``;
-* graceful ``drain()``: stop admitting, finish everything in flight.
+* graceful ``drain()``: stop admitting, finish everything in flight;
+* priority PREEMPTION (``preemption=True``, the default): when the
+  head of the waiting queue has STRICTLY higher priority than the
+  lowest-priority active request and capacity blocks it, the victim
+  is suspended — its KV pages swap into the engine's host pool (or
+  are recomputed at resume) and its slot frees NOW.  The victim
+  re-enters the priority queue in the SUSPENDED state and resumes
+  through the same admission path when capacity allows, continuing
+  with bit-identical tokens.  ``max_preemptions_per_request`` bounds
+  how many times one request can be evicted (no livelock: after the
+  bound it holds its slot to completion);
+* bin-packing admission (``packing=True``, opt-in): when the head
+  does not fit, smaller waiters that DO fit admit around it —
+  bounded by an aging rule (``packing_max_overtakes`` admissions may
+  overtake one blocked head, then strict order resumes) so a big
+  request is delayed, never starved.
 
 Determinism contract: the scheduler adds policy, never math — tokens
 are bit-identical to driving the engine directly with the same
@@ -67,6 +82,7 @@ _QWAIT_BUCKETS = (.001, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5,
 
 WAITING = "waiting"
 ACTIVE = "active"
+SUSPENDED = "suspended"      # preempted: in the queue, tokens so far kept
 FINISHED = "finished"
 CANCELLED = "cancelled"
 SHED = "shed"
@@ -102,6 +118,16 @@ class ScheduledRequest:
         self.tokens: List[int] = []
         self.deadline_missed = False
         self.shed_reason: Optional[str] = None
+        # preemption bookkeeping: times this request has been evicted,
+        # when the current suspension started, packing-aging overtakes
+        # while this request blocked the head of the queue, and
+        # whether the record currently sits in the admission heap
+        # (suspended records re-enter it; packed admissions leave a
+        # stale entry that must not be double-pushed)
+        self.preempts = 0
+        self.preempt_t: Optional[float] = None
+        self.overtaken = 0
+        self.in_heap = False
 
     def __lt__(self, other):                # heapq tie-breaks via seq
         return (self.priority, self.seq) < (other.priority, other.seq)
@@ -116,21 +142,40 @@ class Scheduler:
     ``max_queue_time`` is the default queue-time budget (seconds,
     None = unlimited), overridable per request; ``clock`` is
     injectable (tests pass a fake) and defaults to
-    ``time.monotonic``."""
+    ``time.monotonic``; ``preemption``/``max_preemptions_per_request``
+    and ``packing``/``packing_max_overtakes`` select the preemption
+    and bin-packing admission policies (module docstring).  Suspended
+    requests do NOT count against ``max_queue`` (they were already
+    admitted once; shedding them would discard computed tokens) and
+    are never expired by queue timers — only ``cancel`` or their
+    deadline at delivery touches them."""
 
     def __init__(self, engine, max_queue: int = 64,
                  max_queue_time: Optional[float] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 enable_metrics: bool = True):
+                 enable_metrics: bool = True,
+                 preemption: bool = True,
+                 max_preemptions_per_request: int = 2,
+                 packing: bool = False,
+                 packing_max_overtakes: int = 8):
         enforce(max_queue >= 1, "max_queue must be >= 1")
+        enforce(max_preemptions_per_request >= 0,
+                "max_preemptions_per_request must be >= 0")
+        enforce(packing_max_overtakes >= 1,
+                "packing_max_overtakes must be >= 1")
         self.engine = engine
         self.max_queue = max_queue
         self.default_max_queue_time = max_queue_time
+        self.preemption = bool(preemption)
+        self.max_preemptions_per_request = max_preemptions_per_request
+        self.packing = bool(packing)
+        self.packing_max_overtakes = packing_max_overtakes
         self._clock = clock or time.monotonic
         self._lock = threading.RLock()
         self._reqs: Dict[object, ScheduledRequest] = {}
         self._heap: List[ScheduledRequest] = []
         self._n_waiting = 0
+        self._n_suspended = 0
         self._seq = itertools.count()
         self._pending_abort: List[object] = []
         self._draining = False
@@ -177,6 +222,22 @@ class Scheduler:
                 "serving_sched_waiting",
                 "Requests in the bounded waiting queue.",
                 lbl).labels(sid),
+            "preempted": reg.counter(
+                "serving_sched_preempted_total",
+                "Active requests evicted (suspended) so a strictly "
+                "higher-priority waiter could admit.", lbl).labels(sid),
+            "packed": reg.counter(
+                "serving_sched_packed_admissions_total",
+                "Requests admitted around a blocked head of queue "
+                "(bin-packing admission).", lbl).labels(sid),
+            "suspended": reg.gauge(
+                "serving_sched_suspended",
+                "Preempted requests waiting to resume.", lbl).labels(
+                    sid),
+            "time_preempted": reg.histogram(
+                "serving_sched_time_preempted_seconds",
+                "Wall time a preempted request spent suspended before "
+                "resuming.", lbl, buckets=_QWAIT_BUCKETS).labels(sid),
         }
 
     def _shed_inc(self, reason: str):
@@ -187,6 +248,7 @@ class Scheduler:
     def _set_waiting_gauge(self):
         if self._metrics is not None:
             self._metrics["waiting"].set(self._n_waiting)
+            self._metrics["suspended"].set(self._n_suspended)
 
     # -- submission / cancellation (any thread) --------------------------------
     def submit(self, rid, prompt_ids, max_new_tokens: int = 64,
@@ -242,6 +304,7 @@ class Scheduler:
                 else None, mqt, now, on_event, next(self._seq))
             self._reqs[rid] = rec
             heapq.heappush(self._heap, rec)
+            rec.in_heap = True
             self._n_waiting += 1
             self._set_waiting_gauge()
         return rid
@@ -265,7 +328,9 @@ class Scheduler:
                 self._set_waiting_gauge()
                 self._event(events, rec, {"type": "cancelled",
                                           "rid": rid, "tokens": []})
-            elif rec.state == ACTIVE:
+            elif rec.state in (ACTIVE, SUSPENDED):
+                # engine state (pages, swap pool) is only touched from
+                # the stepping thread — defer to the next step()
                 self._pending_abort.append(rid)
             else:
                 self._dispatch(events)
@@ -302,10 +367,11 @@ class Scheduler:
         return out
 
     def busy(self) -> bool:
-        """True while anything is waiting, active, or pending abort."""
+        """True while anything is waiting, suspended, active, or
+        pending abort."""
         with self._lock:
-            return bool(self._n_waiting or self._pending_abort) or \
-                self.engine.has_work()
+            return bool(self._n_waiting or self._n_suspended or
+                        self._pending_abort) or self.engine.has_work()
 
     def run_until_idle(self, max_steps: Optional[int] = None
                        ) -> Dict[object, List[int]]:
@@ -388,6 +454,7 @@ class Scheduler:
             snap = {
                 "sched": self.sched_id,
                 "waiting": self._n_waiting,
+                "suspended": self._n_suspended,
                 "draining": self._draining,
                 "states": states,
                 "shed": dict(self.shed_stats,
@@ -401,6 +468,10 @@ class Scheduler:
                     "completed": int(m["completed"].value),
                     "aborted": int(m["aborts"].value),
                     "deadline_miss": int(m["deadline_miss"].value),
+                    "preempted": int(m["preempted"].value),
+                    "packed_admissions": int(m["packed"].value),
+                    "time_preempted_seconds":
+                        m["time_preempted"]._snapshot_value(),
                     "queue_wait_seconds":
                         m["queue_wait"]._snapshot_value(),
                 })
@@ -419,14 +490,17 @@ class Scheduler:
     def _process_aborts(self, events):
         for rid in self._pending_abort:
             rec = self._reqs.get(rid)
-            if rec is None or rec.state != ACTIVE:
+            if rec is None or rec.state not in (ACTIVE, SUSPENDED):
                 continue                     # finished in the meantime
             if self.engine.abort(rid):
+                if rec.state == SUSPENDED:
+                    self._n_suspended -= 1
                 rec.tokens = self.engine.pop_result(rid)
                 rec.state = CANCELLED
                 rec.finish_t = self._clock()
                 if self._metrics is not None:
                     self._metrics["aborts"].inc()
+                self._set_waiting_gauge()
                 self._event(events, rec,
                             {"type": "cancelled", "rid": rid,
                              "tokens": list(rec.tokens)})
@@ -459,40 +533,126 @@ class Scheduler:
                                       "reason": reason})
         self._set_waiting_gauge()
 
+    def _need(self, rec) -> int:
+        P = self.engine.cache.page_size
+        return -(-(len(rec.prompt) + rec.max_new) // P)
+
     def _admit(self, events, out):
         """Admit from the priority queue while the engine has a free
         slot and the paged cache holds the head request's FULL page
-        budget.  Head-of-line order is strict (priority, then FIFO):
-        a big high-priority request blocks smaller later ones rather
-        than being starved by them — predictability over packing
-        (bin-packing admission is a ROADMAP open item)."""
+        budget (the ``capacity()`` snapshot — one atomic read per
+        decision, see its invariant).  Head-of-line order is
+        (priority, FIFO); a blocked head may trigger PREEMPTION of a
+        strictly-lower-priority active request, and the opt-in
+        packing mode may admit smaller waiters around it (bounded by
+        the aging rule) — both documented in the module docstring.
+        Suspended requests re-admit through this same path: their
+        heap position is their original (priority, seq), so a
+        preempted request resumes ahead of later arrivals of its own
+        class."""
         eng = self.engine
-        P = eng.cache.page_size
         while self._heap:
             rec = self._heap[0]
-            if rec.state != WAITING:         # cancelled/shed in queue
-                heapq.heappop(self._heap)
+            if rec.state not in (WAITING, SUSPENDED):
+                heapq.heappop(self._heap)    # cancelled/shed/packed
+                rec.in_heap = False
                 continue
-            need = -(-(len(rec.prompt) + rec.max_new) // P)
-            if eng.free_slots() < 1 or eng.cache.free_pages() < need:
+            slots, pages = eng.capacity()
+            if slots < 1 or pages < self._need(rec):
+                if self.preemption and self._try_preempt(rec, events):
+                    continue                 # capacity freed: re-check
+                if self.packing:
+                    self._admit_packed(events, out)
                 break
             heapq.heappop(self._heap)
-            now = self._clock()
-            eng.add_request(rec.rid, rec.prompt,
-                            max_new_tokens=rec.max_new,
-                            eos_token_id=rec.eos)
-            rec.state = ACTIVE
-            rec.admit_t = now
-            self._n_waiting -= 1
-            if self._metrics is not None:
-                self._metrics["queue_wait"].observe(now - rec.submit_t)
-                self._metrics["admitted"].inc()
-            first = list(eng.requests[rec.rid].out)
-            rec.tokens.extend(first)
-            out.setdefault(rec.rid, []).extend(first)
-            self._event(events, rec, {"type": "tokens", "rid": rec.rid,
-                                      "tokens": first})
+            rec.in_heap = False
+            self._admit_one(rec, events, out)
         self._set_waiting_gauge()
+
+    def _admit_one(self, rec, events, out):
+        """Move one WAITING or SUSPENDED record into the engine (the
+        caller has verified capacity and owns the heap entry)."""
+        eng = self.engine
+        now = self._clock()
+        if rec.state == SUSPENDED:
+            eng.resume(rec.rid)
+            rec.state = ACTIVE
+            self._n_suspended -= 1
+            if self._metrics is not None and rec.preempt_t is not None:
+                self._metrics["time_preempted"].observe(
+                    now - rec.preempt_t)
+            rec.preempt_t = None
+            return
+        eng.add_request(rec.rid, rec.prompt,
+                        max_new_tokens=rec.max_new,
+                        eos_token_id=rec.eos)
+        rec.state = ACTIVE
+        rec.admit_t = now
+        self._n_waiting -= 1
+        if self._metrics is not None:
+            self._metrics["queue_wait"].observe(now - rec.submit_t)
+            self._metrics["admitted"].inc()
+        first = list(eng.requests[rec.rid].out)
+        rec.tokens.extend(first)
+        out.setdefault(rec.rid, []).extend(first)
+        self._event(events, rec, {"type": "tokens", "rid": rec.rid,
+                                  "tokens": first})
+
+    def _try_preempt(self, head, events) -> bool:
+        """Evict ONE active request so ``head`` can admit: the victim
+        is the lowest-priority active request STRICTLY below the
+        head's priority (youngest within that class — it has computed
+        the least), provided it has not already been preempted
+        ``max_preemptions_per_request`` times (the livelock bound: a
+        request past the bound keeps its slot to completion).
+        Returns True when a victim was suspended — the caller
+        re-checks capacity and may preempt again if one eviction was
+        not enough."""
+        cands = [r for r in self._reqs.values()
+                 if r.state == ACTIVE and r.priority > head.priority
+                 and r.preempts < self.max_preemptions_per_request]
+        if not cands:
+            return False
+        victim = max(cands, key=lambda r: (r.priority, r.seq))
+        self.engine.suspend(victim.rid)
+        victim.state = SUSPENDED
+        victim.preempts += 1
+        victim.preempt_t = self._clock()
+        self._n_suspended += 1
+        if not victim.in_heap:
+            heapq.heappush(self._heap, victim)
+            victim.in_heap = True
+        if self._metrics is not None:
+            self._metrics["preempted"].inc()
+        self._event(events, victim,
+                    {"type": "preempted", "rid": victim.rid,
+                     "n_tokens": len(victim.tokens)})
+        return True
+
+    def _admit_packed(self, events, out):
+        """Bin-packing admission around a blocked head: walk the rest
+        of the queue in (priority, FIFO) order and admit requests
+        whose full page budget fits.  Aging-based starvation bound:
+        each packed admission charges the head one overtake; at
+        ``packing_max_overtakes`` the head stops being overtaken and
+        strict order resumes until it admits."""
+        head = self._heap[0]
+        for rec in sorted(self._heap)[1:]:
+            if head.overtaken >= self.packing_max_overtakes:
+                break
+            if rec.state not in (WAITING, SUSPENDED):
+                continue
+            slots, pages = self.engine.capacity()
+            if slots < 1:
+                break
+            if pages < self._need(rec):
+                continue
+            # the heap entry stays (state != WAITING/SUSPENDED pops it
+            # lazily at the head later)
+            self._admit_one(rec, events, out)
+            head.overtaken += 1
+            if self._metrics is not None:
+                self._metrics["packed"].inc()
 
     def _retire_done(self, events):
         for rid, ereq in list(self.engine.requests.items()):

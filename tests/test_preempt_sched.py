@@ -1,0 +1,335 @@
+"""Preemptive scheduling policy + bin-packing admission (ISSUE 5).
+
+Contracts under test:
+* a strictly-higher-priority waiter evicts the lowest-priority active
+  request; both finish with tokens bit-identical to direct engine
+  runs, zero OOM events, ``prefill_compiles() == 1`` intact;
+* ``max_preemptions_per_request`` bounds eviction (no livelock);
+* recompute resume path (swap pool disabled) stays exact;
+* ``packing=True`` admits smaller waiters around a blocked head;
+  ``packing_max_overtakes`` (the aging bound) stops the overtaking;
+* router: preemption-inflated load steers routing, ties break
+  deterministically, and a replica ``RejectedError`` does NOT trip
+  the circuit breaker (PR 4 regression lock);
+* the soak test (many evict/resume cycles) is ``slow``-marked, and a
+  tier-1 budget guard keeps this module's fast-test footprint flat.
+"""
+import re
+from pathlib import Path
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import engine as E
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import RejectedError, ReplicaRouter, Scheduler
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return m
+
+
+def _direct(model, prompt, n, **ekw):
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8, **ekw)
+    eng.add_request("ref", prompt, max_new_tokens=n)
+    while eng.has_work():
+        eng.step()
+    return eng.result("ref")
+
+
+def _one_slot_engine(model, **kw):
+    kw.setdefault("enable_prefix_caching", False)
+    return LLMEngine(model, max_seqs=1, max_len=32, page_size=8,
+                     n_pages=5, **kw)
+
+
+# -- preemption policy ---------------------------------------------------------
+def test_preemption_admits_high_priority_both_exact(model):
+    """One slot, low-priority long decode active: a high-priority
+    arrival evicts it, runs, and the victim resumes — both streams
+    bit-identical to unpreempted runs, no OOM, no recompiles."""
+    want_lo = _direct(model, [1, 2, 3], 16)
+    want_hi = _direct(model, [7, 8, 9], 4)
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=8)
+    events = []
+    sched.submit("lo", [1, 2, 3], max_new_tokens=16, priority=1,
+                 on_event=lambda ev: events.append(ev["type"]))
+    sched.step()                    # lo prefilled: this geometry's
+    sched.step()                    # chunk program is compiled now
+    pre_c = E._paged_prefill_chunk._cache_size()
+    sched.submit("hi", [7, 8, 9], max_new_tokens=4, priority=0)
+    sched.run_until_idle()
+    assert sched.result("lo") == want_lo
+    assert sched.result("hi") == want_hi
+    assert "preempted" in events
+    snap = sched.metrics_snapshot()
+    assert snap["preempted"] == 1
+    assert snap["time_preempted_seconds"]["count"] == 1
+    assert snap["engine"]["kv_cache"]["oom_events"] == 0
+    assert snap["engine"]["kv_cache"]["swap_out_pages"] >= 1
+    assert E._paged_prefill_chunk._cache_size() == pre_c
+    assert sched._reqs["lo"].preempts == 1
+
+
+def test_equal_priority_never_preempts(model):
+    """Preemption needs STRICTLY higher priority — same-class arrivals
+    wait their FIFO turn (the PR 4 behavior, unchanged)."""
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=8)
+    sched.submit("first", [1, 2, 3], max_new_tokens=8, priority=1)
+    sched.step()
+    sched.submit("second", [4, 5, 6], max_new_tokens=4, priority=1)
+    sched.step()
+    assert sched.status("first") == "active"
+    assert sched.status("second") == "waiting"
+    sched.run_until_idle()
+    assert sched.metrics_snapshot()["preempted"] == 0
+
+
+def test_max_preemptions_bound_prevents_livelock(model):
+    """A request evicted ``max_preemptions_per_request`` times keeps
+    its slot: later high-priority arrivals wait instead of thrashing
+    it forever."""
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=8,
+                      max_preemptions_per_request=1)
+    sched.submit("lo", [1, 2, 3], max_new_tokens=16, priority=2)
+    sched.step()
+    sched.submit("hi1", [7, 8, 9], max_new_tokens=2, priority=0)
+    while sched.status("hi1") != "finished":
+        sched.step()
+    assert sched.status("lo") == "suspended"
+    # drive until lo holds the slot again
+    while sched.status("lo") != "active":
+        sched.step()
+    sched.submit("hi2", [7, 8, 9], max_new_tokens=2, priority=0)
+    sched.step()
+    assert sched.status("lo") == "active"             # at the bound
+    assert sched.status("hi2") == "waiting"
+    sched.run_until_idle()
+    assert sched.metrics_snapshot()["preempted"] == 1
+    assert sched.result("lo") == _direct(model, [1, 2, 3], 16)
+
+
+def test_preemption_recompute_path_exact(model):
+    """Swap pool disabled: the victim resumes through the recompute
+    replay — still bit-identical, still one prefill program."""
+    want_lo = _direct(model, [1, 2, 3], 12)
+    eng = _one_slot_engine(model, swap_pool_pages=0)
+    sched = Scheduler(eng, max_queue=8)
+    sched.submit("lo", [1, 2, 3], max_new_tokens=12, priority=1)
+    sched.step()
+    sched.step()
+    sched.submit("hi", [7, 8, 9], max_new_tokens=2, priority=0)
+    sched.run_until_idle()
+    assert sched.result("lo") == want_lo
+    snap = sched.metrics_snapshot()
+    assert snap["preempted"] == 1
+    assert snap["engine"]["kv_cache"]["swap_fallbacks"] >= 1
+
+
+def test_cancel_suspended_request_drops_swap(model):
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=8)
+    sched.submit("lo", [1, 2, 3], max_new_tokens=16, priority=1)
+    sched.step()
+    sched.submit("hi", [7, 8, 9], max_new_tokens=8, priority=0)
+    sched.step()                                      # lo preempted
+    assert sched.status("lo") == "suspended"
+    assert sched.cancel("lo") is True
+    sched.step()                                      # abort processed
+    assert sched.status("lo") == "cancelled"
+    assert len(sched.result("lo")) >= 1               # partial, defined
+    assert eng.cache.swap_pool_used() == 0
+    sched.run_until_idle()
+    assert len(sched.result("hi")) == 8
+    assert not sched.busy()
+
+
+# -- bin-packing admission -----------------------------------------------------
+def _packing_setup(model, **skw):
+    """2 slots, 4 usable pages: 'blocker' (1 page) active, 'big'
+    (4 pages) blocked at the head, two 1-page waiters behind it."""
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    n_pages=5, enable_prefix_caching=False)
+    sched = Scheduler(eng, max_queue=8, **skw)
+    admitted = []
+
+    def watch(rid):
+        def cb(ev):
+            if ev["type"] == "tokens" and rid not in admitted:
+                admitted.append(rid)
+        return cb
+
+    sched.submit("blocker", [1, 2, 3], max_new_tokens=5,
+                 on_event=watch("blocker"))
+    sched.step()
+    sched.submit("big", list(range(1, 9)), max_new_tokens=24,
+                 on_event=watch("big"))               # 32 tok = 4 pages
+    sched.submit("s1", [4, 5], max_new_tokens=5, on_event=watch("s1"))
+    sched.submit("s2", [6, 7], max_new_tokens=5, on_event=watch("s2"))
+    return sched, admitted
+
+
+def test_packing_admits_smaller_around_blocked_head(model):
+    sched, admitted = _packing_setup(model, packing=True)
+    sched.run_until_idle()
+    assert admitted == ["blocker", "s1", "s2", "big"]
+    snap = sched.metrics_snapshot()
+    assert snap["packed_admissions"] == 2
+    assert snap["engine"]["kv_cache"]["oom_events"] == 0
+    for rid in ("blocker", "big", "s1", "s2"):
+        assert sched.result(rid) == _direct(
+            model, sched._reqs[rid].prompt, sched._reqs[rid].max_new)
+
+
+def test_packing_off_keeps_strict_head_of_line(model):
+    sched, admitted = _packing_setup(model)           # packing=False
+    sched.run_until_idle()
+    assert admitted == ["blocker", "big", "s1", "s2"]
+    assert sched.metrics_snapshot()["packed_admissions"] == 0
+
+
+def test_packing_starvation_bound_stops_overtaking(model):
+    """The aging bound: after ``packing_max_overtakes`` packed
+    admissions the blocked head stops being overtaken — s2 waits for
+    the head even though it would fit."""
+    sched, admitted = _packing_setup(model, packing=True,
+                                     packing_max_overtakes=1)
+    sched.run_until_idle()
+    assert admitted == ["blocker", "s1", "big", "s2"]
+    assert sched.metrics_snapshot()["packed_admissions"] == 1
+    assert sched._reqs["big"].overtaken == 1
+
+
+# -- router: preemption-inflated load ------------------------------------------
+def test_router_counts_suspended_in_load_and_breaks_ties(model):
+    """A replica mid-preemption (1 active + 1 suspended) reports load
+    2: new traffic steers to the emptier replica; an exact tie breaks
+    on replica index (deterministic)."""
+    r0 = Scheduler(_one_slot_engine(model), max_queue=4)
+    r1 = Scheduler(_one_slot_engine(model), max_queue=4)
+    router = ReplicaRouter([r0, r1], sleep=lambda s: None)
+    r0.submit("lo", [1, 2, 3], max_new_tokens=16, priority=1)
+    r0.step()
+    r0.submit("hi", [7, 8, 9], max_new_tokens=8, priority=0)
+    r0.step()                                         # lo suspended
+    assert r0.status("lo") == "suspended"
+    assert router._load(0) == 2                       # active + suspended
+    assert router._load(1) == 0
+    assert router.submit("n1", [4, 5], max_new_tokens=8) == 1
+    assert router.submit("n2", [4, 6], max_new_tokens=8) == 1
+    # r1 now has 1 active + 1 waiting = 2 == r0's load: tie -> index 0
+    r1.step()
+    assert router._load(1) == 2
+    assert router.submit("n3", [4, 7], max_new_tokens=2) == 0
+    router.run_until_idle()
+    for rid in ("lo", "hi"):                          # direct submits
+        assert len(r0.result(rid)) >= 1
+    for rid in ("n1", "n2", "n3"):                    # routed submits
+        assert len(router.result(rid)) >= 1
+
+
+def test_rejected_is_load_signal_not_failure_regression(model):
+    """PR 4 regression lock: every replica shedding (RejectedError)
+    propagates the rejection but never opens a circuit — the breaker
+    is for faults, not load."""
+    router = ReplicaRouter(
+        [Scheduler(_one_slot_engine(model), max_queue=1)
+         for _ in range(2)],
+        failure_threshold=1, sleep=lambda s: None)
+    for i in range(2):                                # one active each
+        router.submit(f"a{i}", [1 + i, 2, 3], max_new_tokens=4)
+    router.step()
+    for i in range(2):                                # fill both queues
+        router.submit(f"w{i}", [3 + i, 2], max_new_tokens=2)
+    with pytest.raises(RejectedError):
+        router.submit("overflow", [9, 9], max_new_tokens=2)
+    assert router.healthy_replicas() == [0, 1]        # no circuit trip
+    router.run_until_idle()
+    assert len(router.result("a0")) == 4
+
+
+# -- soak (slow) + tier-1 budget guard -----------------------------------------
+@pytest.mark.slow
+def test_preempt_soak_many_evict_resume_cycles(model):
+    """Livelock/leak soak: a long low-priority decode is evicted and
+    resumed once per high-priority arrival, many times over — tokens
+    stay exact, pages and swap pool balance to zero, nothing OOMs."""
+    want = _direct(model, [1, 2, 3], 24)
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=8,
+                      max_preemptions_per_request=100)
+    sched.submit("lo", [1, 2, 3], max_new_tokens=24, priority=1)
+    sched.step()
+    for i in range(8):
+        sched.submit(f"hi{i}", [7, 8, 9], max_new_tokens=2, priority=0)
+        while sched.status(f"hi{i}") != "finished":
+            sched.step()
+        # wait for the victim to resume before the next eviction —
+        # each loop iteration is one full evict/resume cycle
+        while sched.status("lo") not in ("active", "finished"):
+            sched.step()
+    sched.run_until_idle()
+    assert sched.result("lo") == want
+    snap = sched.metrics_snapshot()
+    assert snap["preempted"] == 8
+    assert snap["engine"]["kv_cache"]["oom_events"] == 0
+    assert eng.cache.swap_pool_used() == 0
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+
+
+def test_tier1_budget_guard():
+    """Budget guard for the 870 s tier-1 timeout (ROADMAP): the
+    preemption soak is ``slow``-marked (excluded from tier-1), the
+    fast-test footprint of the two new preemption modules stays
+    bounded, and the tier-1 command still excludes ``slow``."""
+    here = Path(__file__).resolve().parent
+    src_sched = (here / "test_preempt_sched.py").read_text()
+    src_eng = (here / "test_preemption.py").read_text()
+    # every soak test must carry the slow marker
+    for src, name in ((src_sched, "test_preempt_sched"),
+                      (src_eng, "test_preemption")):
+        for m in re.finditer(r"((?:@[\w.]+(?:\(.*?\))?\s*\n)*)"
+                             r"def (test_\w*soak\w*)\(", src):
+            assert "pytest.mark.slow" in m.group(1), (
+                f"{name}.{m.group(2)} must be @pytest.mark.slow")
+    # fast-test count stays bounded: adding preemption tests must not
+    # blow the tier-1 wall-clock budget on the 1-core CI box
+    n_fast = 0
+    for src in (src_sched, src_eng):
+        for m in re.finditer(r"((?:@[\w.]+(?:\(.*?\))?\s*\n)*)"
+                             r"def test_\w+\(", src):
+            if "pytest.mark.slow" not in m.group(1):
+                n_fast += 1
+    assert n_fast <= 30, (
+        f"{n_fast} fast preemption tests — move the heavy ones behind "
+        f"@pytest.mark.slow to protect the 870 s tier-1 budget")
+    roadmap = (here.parent / "ROADMAP.md").read_text()
+    assert "not slow" in roadmap and "870" in roadmap, (
+        "tier-1 command must keep excluding slow tests within the "
+        "870 s budget")
+
+
+def test_preemption_metrics_exposed(model):
+    eng = _one_slot_engine(model)
+    sched = Scheduler(eng, max_queue=4)
+    sched.submit("lo", [1, 2, 3], max_new_tokens=8, priority=1)
+    sched.step()
+    sched.submit("hi", [7, 8, 9], max_new_tokens=2, priority=0)
+    sched.run_until_idle()
+    text = paddle.observability.get_registry().expose_text()
+    assert "serving_sched_preempted_total" in text
+    assert "serving_sched_suspended" in text
+    assert "serving_sched_time_preempted_seconds_bucket" in text
+    assert "serving_sched_packed_admissions_total" in text
+    assert "kv_cache_swap_pool_pages" in text
+    snap = sched.metrics_snapshot()
+    assert snap["suspended"] == 0                     # all resumed
+    assert snap["preempted"] == 1

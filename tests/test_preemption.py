@@ -1,0 +1,340 @@
+"""KV swap + engine suspend/resume — the preemption primitives.
+
+Contracts under test (ISSUE 5 tentpole):
+* ``PagedKVCache.swap_out``/``swap_in``: device pages (and int8 scale
+  rows) round-trip through the bounded host swap pool byte-exact;
+  shared prefix pages are unpinned + re-pinned by chain key, never
+  copied; a full/disabled pool and an evicted shared page degrade to
+  the recompute fallback (``None``), never to corruption;
+* ``LLMEngine.suspend``/``resume``: a preempted-and-resumed request
+  produces BIT-IDENTICAL tokens to an unpreempted run on BOTH restore
+  paths (swap-in and recompute), with ``prefill_compiles() == 1`` and
+  ``decode_compiles()`` unchanged;
+* ``abort`` is idempotent across the suspended state and drops the
+  swap-pool entry;
+* ``capacity()`` is the atomic admission snapshot.
+
+Everything runs JAX_PLATFORMS=cpu on the tiny llama config.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.common.errors import EnforceError
+from paddle_tpu.inference import engine as E
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.inference.paged_cache import PagedKVCache
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return m
+
+
+def _direct(model, prompt, n, **ekw):
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8, **ekw)
+    eng.add_request("ref", prompt, max_new_tokens=n)
+    while eng.has_work():
+        eng.step()
+    return eng.result("ref")
+
+
+def _mk_cache(**kw):
+    cfg = dict(n_pages=9, page_size=4, n_kv_heads=1, head_dim=4,
+               max_seqs=2, max_len=16, num_layers=2,
+               swap_pool_pages=8)
+    cfg.update(kw)
+    return PagedKVCache(**cfg)
+
+
+def _fill(cache, slot, n_tok, seed=0):
+    rng = np.random.default_rng(seed)
+    L = cache.num_layers
+    k = rng.standard_normal((L, n_tok, 1, 4)).astype(np.float32)
+    v = rng.standard_normal((L, n_tok, 1, 4)).astype(np.float32)
+    cache.write_prefill(slot, k, v)
+
+
+# -- cache: swap round-trip ----------------------------------------------------
+def test_swap_roundtrip_bytes_exact():
+    cache = _mk_cache()
+    slot = cache.allocate(10)
+    _fill(cache, slot, 7)
+    before = np.asarray(cache.k_pages), np.asarray(cache.v_pages)
+    pages_before = list(cache._pages[slot])
+    handle = cache.swap_out(slot)
+    assert handle is not None
+    assert cache.free_pages() == cache.n_pages - 1    # all device freed
+    assert cache.swap_pool_used() == 2                # 2 written pages
+    slot2 = cache.swap_in(handle, 10)
+    assert slot2 is not None
+    cache.set_len(slot2, 7)
+    after = np.asarray(cache.k_pages), np.asarray(cache.v_pages)
+    for i in range(2):                                # written pages
+        src, dst = pages_before[i], cache._pages[slot2][i]
+        assert np.array_equal(before[0][:, :, src], after[0][:, :, dst])
+        assert np.array_equal(before[1][:, :, src], after[1][:, :, dst])
+    assert cache.swap_pool_used() == 0                # pool space freed
+    # the full 10-token budget is re-reserved, like allocate
+    assert len(cache._pages[slot2]) == 3
+    snap = cache.metrics_snapshot()
+    assert snap["swap_out_pages"] == 2 and snap["swap_in_pages"] == 2
+    assert snap["oom_events"] == 0
+
+
+def test_swap_pool_bound_falls_back_to_release():
+    cache = _mk_cache(swap_pool_pages=1)              # < 2 written pages
+    slot = cache.allocate(8)
+    _fill(cache, slot, 8)
+    assert cache.swap_out(slot) is None               # pool can't hold
+    assert cache.free_pages() == cache.n_pages - 1    # still released
+    assert cache.swap_pool_used() == 0
+    assert cache.metrics_snapshot()["swap_fallbacks"] == 1
+
+
+def test_swap_disabled_always_falls_back():
+    cache = _mk_cache(swap_pool_pages=0)
+    slot = cache.allocate(4)
+    _fill(cache, slot, 4)
+    assert cache.swap_out(slot) is None
+    assert cache.free_pages() == cache.n_pages - 1
+
+
+def test_swap_shared_prefix_pages_unpinned_not_copied():
+    cache = _mk_cache()
+    a = cache.allocate(8)
+    tokens = list(range(1, 9))
+    _fill(cache, a, 8)
+    cache.register_prefix(a, tokens)
+    n_cached, shared = cache.lookup_prefix(tokens)
+    assert n_cached == 8 and len(shared) == 2
+    b = cache.allocate(12, shared_pages=shared)
+    cache.set_len(b, 8)
+    assert all(cache.page_ref_count(p) == 2 for p in shared)
+    handle = cache.swap_out(b)
+    # only private pages would be copied — b has none written beyond
+    # the shared prefix, so the pool holds nothing for it
+    assert cache.swap_pool_used() == 0
+    assert all(cache.page_ref_count(p) == 1 for p in shared)  # unpinned
+    slot = cache.swap_in(handle, 12)
+    assert slot is not None
+    # shared pages re-pinned by chain key, not re-allocated
+    assert cache._pages[slot][:2] == shared
+    assert all(cache.page_ref_count(p) == 2 for p in shared)
+    cache.release(slot)
+    cache.release(a)
+
+
+def test_swap_in_fails_cleanly_when_shared_page_evicted():
+    cache = _mk_cache(n_pages=6)                      # 5 usable
+    a = cache.allocate(4)
+    tokens = [9, 8, 7, 6]
+    _fill(cache, a, 4)
+    cache.register_prefix(a, tokens)
+    _, shared = cache.lookup_prefix(tokens)
+    b = cache.allocate(8, shared_pages=shared)
+    cache.set_len(b, 4)
+    handle = cache.swap_out(b)
+    cache.release(a)                                  # prefix page -> LRU
+    # page pressure evicts the registered page out of the LRU pool
+    c = cache.allocate(16)
+    d = cache.allocate(4)
+    assert cache.cached_page_count() == 0
+    assert cache.swap_in(handle, 8) is None           # recompute signal
+    assert cache.metrics_snapshot()["swap_fallbacks"] >= 1
+    cache.release(c)
+    cache.release(d)
+
+
+def test_swap_in_fails_cleanly_when_pages_short():
+    cache = _mk_cache(max_seqs=3)
+    slot = cache.allocate(8)
+    _fill(cache, slot, 8)
+    handle = cache.swap_out(slot)
+    hog1 = cache.allocate(16)                         # 4 of 8 pages
+    hog2 = cache.allocate(12)                         # 3 more
+    assert cache.swap_in(handle, 8) is None           # 2 needed, 1 free
+    assert cache.swap_pool_used() == 0                # entry consumed
+    cache.release(hog1)
+    cache.release(hog2)
+
+
+def test_drop_swap_idempotent():
+    cache = _mk_cache()
+    slot = cache.allocate(4)
+    _fill(cache, slot, 4)
+    handle = cache.swap_out(slot)
+    assert cache.drop_swap(handle) is True
+    assert cache.drop_swap(handle) is False           # already gone
+    assert cache.drop_swap(None) is False             # recompute path
+    assert cache.swap_pool_used() == 0
+    assert cache.swap_in(handle, 4) is None           # dropped entry
+
+
+# -- engine: suspend / resume --------------------------------------------------
+def test_suspend_resume_swap_in_bit_identical(model):
+    want = _direct(model, [5, 9, 2, 14], 12)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    pre_c = E._paged_prefill_chunk._cache_size()
+    dec_c = E._paged_decode_step._cache_size()
+    eng.add_request("x", [5, 9, 2, 14], max_new_tokens=12)
+    eng.step()
+    eng.step()
+    slots0, pages0 = eng.capacity()
+    assert eng.suspend("x") is True                   # swap path armed
+    slots1, pages1 = eng.capacity()
+    assert slots1 == slots0 + 1 and pages1 > pages0   # capacity freed
+    assert eng.suspended_count() == 1 and not eng.has_work()
+    assert eng.resume("x") == "swap_in"
+    while eng.has_work():
+        eng.step()
+    assert eng.result("x") == want
+    assert E._paged_prefill_chunk._cache_size() == pre_c, \
+        "preemption recompiled prefill"
+    assert E._paged_decode_step._cache_size() == dec_c, \
+        "preemption recompiled decode"
+
+
+def test_suspend_resume_recompute_bit_identical(model):
+    want = _direct(model, [5, 9, 2, 14], 12)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8,
+                    swap_pool_pages=0)                # force recompute
+    pre_c = E._paged_prefill_chunk._cache_size()
+    eng.add_request("y", [5, 9, 2, 14], max_new_tokens=12)
+    eng.step()
+    eng.step()
+    eng.step()
+    assert eng.suspend("y") is False                  # no swap entry
+    assert eng.cache.free_pages() == eng.cache.n_pages - 1
+    assert eng.resume("y") == "recompute"
+    while eng.has_work():
+        eng.step()
+    assert eng.result("y") == want
+    assert E._paged_prefill_chunk._cache_size() == pre_c, \
+        "recompute-resume must reuse the single chunked-prefill program"
+
+
+def test_multiple_preemption_cycles_stay_exact(model):
+    want = _direct(model, [3, 3, 7], 16)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    eng.add_request("z", [3, 3, 7], max_new_tokens=16)
+    paths = []
+    for _ in range(3):
+        eng.step()
+        eng.suspend("z")
+        paths.append(eng.resume("z"))
+    while eng.has_work():
+        eng.step()
+    assert eng.result("z") == want
+    assert paths == ["swap_in"] * 3
+
+
+def test_corunner_unaffected_by_suspension(model):
+    want_b = _direct(model, [3, 3, 7], 10)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    eng.add_request("a", [5, 9, 2, 14], max_new_tokens=12)
+    eng.add_request("b", [3, 3, 7], max_new_tokens=10)
+    eng.step()
+    eng.suspend("a")
+    eng.step()                                        # b decodes alone
+    eng.resume("a")
+    while eng.has_work():
+        eng.step()
+    assert eng.result("b") == want_b                  # co-runner exact
+    assert eng.result("a") == _direct(model, [5, 9, 2, 14], 12)
+
+
+def test_resume_recompute_uses_prefix_cache(model):
+    """With prefix caching on, the recompute replay finds the prompt's
+    pages still registered (its own prefill published them) and skips
+    those chunks — and tokens stay exact."""
+    prompt = list(range(1, 18))                       # 2 full pages + 1
+    want = _direct(model, prompt, 8)
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8,
+                    swap_pool_pages=0, enable_prefix_caching=True)
+    eng.add_request("p", prompt, max_new_tokens=8)
+    eng.step()
+    eng.suspend("p")
+    hits_before = eng.cache.metrics_snapshot()["prefix_cached_pages"]
+    assert hits_before >= 2                           # pages parked in LRU
+    assert eng.resume("p") == "recompute"
+    while eng.has_work():
+        eng.step()
+    assert eng.result("p") == want
+
+
+def test_int8_kv_swap_roundtrip_exact(model):
+    """int8 KV pools swap with their scale rows: a preempted int8 run
+    matches an unpreempted int8 run bit-for-bit."""
+    want = _direct(model, [5, 9, 2, 14], 10, kv_dtype="int8")
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8,
+                    kv_dtype="int8")
+    eng.add_request("q", [5, 9, 2, 14], max_new_tokens=10)
+    eng.step()
+    eng.suspend("q")
+    assert eng.resume("q") == "swap_in"
+    while eng.has_work():
+        eng.step()
+    assert eng.result("q") == want
+
+
+def test_abort_suspended_drops_swap_entry(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    eng.add_request("s", [5, 9, 2, 14], max_new_tokens=16)
+    eng.step()
+    eng.suspend("s")
+    assert eng.cache.swap_pool_used() > 0
+    aborted0 = int(eng._metrics["aborted"].value)
+    assert eng.abort("s") is True
+    assert eng.cache.swap_pool_used() == 0            # entry dropped
+    assert int(eng._metrics["aborted"].value) == aborted0 + 1
+    assert eng.abort("s") is False                    # idempotent
+    toks = eng.result("s")                            # defined: partial
+    assert len(toks) >= 1 and eng.requests["s"].cancelled
+    with pytest.raises(EnforceError):
+        eng.resume("s")                               # retired: no resume
+    # suspend of unknown / retired rids raises clearly
+    with pytest.raises(EnforceError):
+        eng.suspend("never-admitted")
+    with pytest.raises(EnforceError):
+        eng.suspend("s")
+
+
+def test_capacity_is_atomic_snapshot(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=32, page_size=8,
+                    enable_prefix_caching=False)
+    assert eng.capacity() == (2, eng.cache.n_pages - 1)
+    eng.add_request("c", [1, 2, 3], max_new_tokens=8)
+    slots, pages = eng.capacity()
+    assert slots == eng.free_slots()
+    assert pages == eng.cache.free_pages()
+    eng.suspend("c")
+    assert eng.capacity() == (2, eng.cache.n_pages - 1)
+    eng.resume("c")
+    assert eng.capacity() == (slots, pages)
+    while eng.has_work():
+        eng.step()
+
+
+def test_suspend_resume_metrics_and_snapshot(model):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    eng.add_request("m", [5, 9, 2], max_new_tokens=8)
+    eng.step()
+    eng.suspend("m")
+    snap = eng.metrics_snapshot()
+    assert snap["suspended_requests"] == 1
+    assert snap["kv_cache"]["swap_pool_used"] > 0
+    eng.resume("m")
+    assert eng.metrics_snapshot()["suspended_requests"] == 0
+    while eng.has_work():
+        eng.step()
+    text = paddle.observability.get_registry().expose_text()
+    assert "llm_engine_suspended_total" in text
+    assert "llm_engine_resumed_total" in text
+    assert 'path="swap_in"' in text
+    assert "kv_cache_swap_out_pages_total" in text
+    assert "kv_cache_swap_pool_pages" in text
