@@ -1196,6 +1196,101 @@ def bench_serving_preempt():
                   "oom_events": pre_oom + base_oom}}
 
 
+def bench_serving_drain():
+    """Fault-tolerant multi-host row (ISSUE 6): drain a replica with
+    in-flight decodes and resume them on a second replica.  The
+    KV-MIGRATING drain ships each request's swap pages (serialized
+    blob) and swap-ins at the destination; the baseline (swap pools
+    disabled) must RECOMPUTE — replay the prompt through chunked
+    prefill and every generated token through the decode program.
+    Headline value: wall seconds from drain start to all drained
+    requests finished, migration path; vs_baseline is the recompute
+    path's wall on the same schedule.  Both paths must land
+    bit-identical tokens and lose zero requests — the bench asserts
+    it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ReplicaRouter, Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        seqs, page, maxlen = 4, 128, 2048
+        n_req, plen, n_new, warm_steps = 4, 256, 512, 256
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        seqs, page, maxlen = 4, 8, 64
+        n_req, plen, n_new, warm_steps = 3, 4, 32, 16
+        dtype = np.float32
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = {f"d{i}": rng.integers(1, cfg.vocab_size, plen).tolist()
+               for i in range(n_req)}
+
+    def reference(rid):
+        eng = LLMEngine(model, max_seqs=seqs, max_len=maxlen,
+                        page_size=page, dtype=dtype)
+        eng.add_request("ref", prompts[rid], max_new_tokens=n_new)
+        while eng.has_work():
+            eng.step()
+        return eng.result("ref")
+
+    want = {rid: reference(rid) for rid in prompts}
+
+    def run(swap_pool):
+        engines = [LLMEngine(model, max_seqs=seqs, max_len=maxlen,
+                             page_size=page, dtype=dtype,
+                             swap_pool_pages=swap_pool)
+                   for _ in range(2)]
+        router = ReplicaRouter(
+            [Scheduler(e, max_queue=n_req + 1) for e in engines],
+            sleep=lambda s: None)
+        for rid, prompt in prompts.items():
+            router.submit(rid, prompt, max_new_tokens=n_new)
+        src = router._owner[next(iter(prompts))]
+        for _ in range(warm_steps):           # build decode history
+            router.replicas[src].step()
+        t0 = time.perf_counter()
+        moved = router.drain_replica(src)
+        router.run_until_idle()
+        wall = time.perf_counter() - t0
+        lost = [rid for rid in prompts
+                if router.pop_result(rid) != want[rid]]
+        assert not lost, f"drain lost/corrupted requests: {lost}"
+        dst_cache = engines[1 - src].cache.metrics_snapshot()
+        return wall, len(moved), dst_cache
+
+    run(None)                                 # warmup: compiles
+    mig_wall, mig_moved, mig_cache = run(None)     # swap pools on
+    rec_wall, rec_moved, rec_cache = run(0)        # recompute only
+    return {
+        "metric": "serving_drain_migration_seconds",
+        "value": round(mig_wall, 4),
+        "unit": "seconds (drain -> all drained requests finished)",
+        "vs_baseline": round(rec_wall / mig_wall, 3) if mig_wall
+        else None,
+        "extra": {"device_kind": kind, "replicas": 2,
+                  "requests_moved": mig_moved,
+                  "prompt_tokens": plen, "max_new_tokens": n_new,
+                  "decode_steps_before_drain": warm_steps,
+                  "wall_seconds_recompute": round(rec_wall, 4),
+                  "swap_in_pages_migration":
+                      mig_cache["swap_in_pages"],
+                  "swap_imported_pages_migration":
+                      mig_cache["swap_imported_pages"],
+                  "swap_in_pages_recompute":
+                      rec_cache["swap_in_pages"],
+                  "lost_requests": 0}}
+
+
 def jnp_bf16():
     import jax.numpy as jnp
     return jnp.bfloat16
@@ -1312,6 +1407,7 @@ def main():
                ("bench_serving_prefix", bench_serving_prefix),
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),
+               ("bench_serving_drain", bench_serving_drain),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

@@ -564,6 +564,15 @@ class LLMEngine:
                 "Suspended requests re-admitted, by restore path "
                 "(swap_in: host pages copied back; recompute: prompt "
                 "+ generated tokens replayed).", ("engine", "path")),
+            "migrated_out": reg.counter(
+                "llm_engine_migrated_out_total",
+                "Suspended requests exported as migration packages "
+                "(export_request) — they now belong to another "
+                "engine.", lbl).labels(eid),
+            "migrated_in": reg.counter(
+                "llm_engine_migrated_in_total",
+                "Migration packages adopted (import_request) — they "
+                "resume here via resume().", lbl).labels(eid),
             "queue_depth": reg.gauge(
                 "llm_engine_queue_depth",
                 "Requests active in the decode batch.", lbl).labels(eid),
@@ -1018,6 +1027,73 @@ class LLMEngine:
             self.cache.release(slot)
             raise
         return slot
+
+    # -- migration (multi-host drain/rebalance) --------------------------------
+    def export_request(self, rid) -> dict:
+        """Package a SUSPENDED request for migration to another engine:
+        token history (prompt + generated so far) plus its swap entry
+        serialized portably (``PagedKVCache.export_swap``), or
+        ``swap=None`` when the entry was never armed / already dropped
+        — the destination then resumes via recompute, bit-identical
+        either way (same programs, same token history).  The request
+        leaves THIS engine's map: after export it belongs to whoever
+        imports the package.  Suspend first (``suspend(rid)``) —
+        active requests hold device pages that must swap or release
+        before their state can travel."""
+        enforce(rid in self.requests,
+                f"unknown request id {rid!r} (never admitted to this "
+                f"engine)")
+        req = self.requests[rid]
+        enforce(req.suspended and not req.done,
+                f"request {rid!r} is not suspended — suspend() before "
+                f"export_request()")
+        blob = self.cache.export_swap(req.swap_handle)
+        req.swap_handle = None
+        del self.requests[rid]
+        if self._metrics is not None:
+            self._metrics["migrated_out"].inc()
+        return {"rid": rid, "prompt": list(req.prompt),
+                "out": list(req.out), "max_new": req.max_new,
+                "eos": req.eos, "swap": blob}
+
+    def import_request(self, pkg: dict):
+        """Adopt a migration package: the request registers here in
+        the SUSPENDED state (no slot, no device pages) with its swap
+        blob imported into this cache's host pool when it fits —
+        ``resume(rid)`` then restores it exactly like a locally
+        preempted request (swap-in, or recompute from the token
+        history).  Raises when the request cannot fit this engine's
+        limits or the blob's geometry mismatches the cache; the caller
+        (a draining router) tries another destination.  Returns the
+        rid."""
+        rid = pkg["rid"]
+        enforce(rid not in self.requests,
+                f"duplicate request id {rid!r}")
+        plen = len(pkg["prompt"])
+        enforce(plen >= 1, "empty prompt in migration package")
+        total = plen + pkg["max_new"]
+        limit = min(self.max_len,
+                    self.model.config.max_position_embeddings)
+        enforce(total <= limit,
+                f"migrated request {rid!r}: prompt ({plen}) + "
+                f"max_new_tokens ({pkg['max_new']}) exceeds this "
+                f"engine's limit {limit}")
+        P = self.cache.page_size
+        need = -(-total // P)
+        enforce(need <= self.cache.n_pages - 1,
+                f"migrated request {rid!r} needs {need} KV pages but "
+                f"this cache holds {self.cache.n_pages - 1} usable")
+        req = GenRequest(rid, pkg["prompt"], pkg["max_new"], pkg["eos"])
+        req.out = list(pkg["out"])
+        enforce(len(req.out) >= 1,
+                f"migrated request {rid!r} carries no generated "
+                f"tokens — it was never admitted; resubmit it instead")
+        req.suspended = True
+        req.swap_handle = self.cache.import_swap(pkg.get("swap"))
+        self.requests[rid] = req
+        if self._metrics is not None:
+            self._metrics["migrated_in"].inc()
+        return rid
 
     def abort(self, rid) -> bool:
         """Cancel a request: release its KV pages and retire it with

@@ -51,7 +51,9 @@ copies for freed device pages.
 from __future__ import annotations
 
 import hashlib
+import io
 import itertools
+import json
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -195,6 +197,14 @@ class PagedKVCache:
             "kv_cache_swap_pool_pages",
             "Host swap-pool pages currently holding preempted KV.",
             lbl).labels(self.cache_id)
+        self._m_swap_export = reg.counter(
+            "kv_cache_swap_exported_pages_total",
+            "Swap-pool pages serialized into portable migration blobs "
+            "(export_swap).", lbl).labels(self.cache_id)
+        self._m_swap_import = reg.counter(
+            "kv_cache_swap_imported_pages_total",
+            "Swap-pool pages restored from portable migration blobs "
+            "(import_swap).", lbl).labels(self.cache_id)
 
     def page_utilization(self) -> float:
         """Referenced fraction of the usable pool (excludes pad page 0
@@ -512,6 +522,125 @@ class PagedKVCache:
         """Host swap-pool pages currently holding preempted KV."""
         return self._swap_used
 
+    # -- KV migration (multi-host drain/rebalance) -----------------------------
+    def _swap_geometry(self) -> dict:
+        """The shape contract a migration blob must match: mismatched
+        geometry would reinterpret page bytes, so import refuses it."""
+        return {"page_size": self.page_size,
+                "num_layers": self.num_layers,
+                "n_kv_heads": int(self.k_pages.shape[1]),
+                "head_dim": int(self.k_pages.shape[-1]),
+                "kv_dtype": self.kv_dtype or "",
+                "pool_dtype": str(np.dtype(self.k_pages.dtype))}
+
+    def export_swap(self, handle: Optional[int]) -> Optional[bytes]:
+        """Serialize one swap entry into a PORTABLE blob (self-described
+        npz: a json meta record plus the host page arrays) for shipping
+        to another host's cache.  The entry is CONSUMED — its pool pages
+        free immediately, mirroring ``swap_in``'s handle semantics.
+        Shared-prefix plan entries travel as their chain keys (hex), so
+        the destination re-pins them through ITS index — a miss there
+        degrades to the recompute path at resume, never to wrong bytes.
+        ``None`` / already-consumed handles return ``None`` (the caller
+        ships a recompute-only package)."""
+        import jax
+
+        entry = self._swap.pop(handle, None) if handle is not None \
+            else None
+        if entry is None:
+            return None
+        self._swap_used -= entry.n_host_pages
+        self._m_swap_pool.set(self._swap_used)
+        # MATERIALIZE shared-prefix plan entries whose chain key still
+        # resolves locally: the destination's index almost never holds
+        # this host's prefixes, so a key-only blob would degrade every
+        # cross-host migration to recompute.  Registered pages are
+        # immutable, so their bytes can be read out here; keys that no
+        # longer resolve (evicted while suspended) stay keys — the
+        # destination gets one last chance to re-pin, else recompute.
+        n_data = entry.n_host_pages
+        extra_sel: List[int] = []
+        plan: List[tuple] = []
+        for kind, val in entry.plan:
+            if kind == "key":
+                pg = self._index.get(val)
+                if pg is not None:
+                    plan.append(("data", n_data + len(extra_sel)))
+                    extra_sel.append(pg)
+                    continue
+            plan.append((kind, val))
+        k_host, v_host = entry.k_host, entry.v_host
+        ks_host, vs_host = entry.k_scale_host, entry.v_scale_host
+        if extra_sel:
+            sel = np.asarray(extra_sel)
+            ek = np.asarray(jax.device_get(self.k_pages[:, :, sel]))
+            ev = np.asarray(jax.device_get(self.v_pages[:, :, sel]))
+            k_host = ek if k_host is None else \
+                np.concatenate([k_host, ek], axis=2)
+            v_host = ev if v_host is None else \
+                np.concatenate([v_host, ev], axis=2)
+            if self.kv_dtype == "int8":
+                eks = np.asarray(jax.device_get(
+                    self.k_scales[:, :, sel]))
+                evs = np.asarray(jax.device_get(
+                    self.v_scales[:, :, sel]))
+                ks_host = eks if ks_host is None else \
+                    np.concatenate([ks_host, eks], axis=2)
+                vs_host = evs if vs_host is None else \
+                    np.concatenate([vs_host, evs], axis=2)
+        meta = dict(self._swap_geometry())
+        meta["plan"] = [["key", val.hex()] if kind == "key"
+                        else ["data", int(val)]
+                        for kind, val in plan]
+        meta["n_host_pages"] = n_data + len(extra_sel)
+        arrays = {"meta": np.frombuffer(
+            json.dumps(meta).encode("utf-8"), np.uint8)}
+        if k_host is not None:
+            arrays["k_host"] = k_host
+            arrays["v_host"] = v_host
+            if ks_host is not None:
+                arrays["k_scale_host"] = ks_host
+                arrays["v_scale_host"] = vs_host
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        self._m_swap_export.inc(meta["n_host_pages"])
+        return buf.getvalue()
+
+    def import_swap(self, blob: Optional[bytes]) -> Optional[int]:
+        """Adopt a migrated swap blob into THIS cache's host pool and
+        return a local handle ``swap_in`` understands.  Geometry
+        mismatches raise (an operator wiring error, not a degradable
+        fault); a pool that cannot hold the blob's pages returns
+        ``None`` — the caller resumes via recompute instead, so a small
+        destination never blocks a drain."""
+        if blob is None:
+            return None
+        with np.load(io.BytesIO(blob)) as z:
+            meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+            geo = self._swap_geometry()
+            for k, v in geo.items():
+                enforce(meta.get(k) == v,
+                        f"migration blob geometry mismatch: {k} is "
+                        f"{meta.get(k)!r}, this cache has {v!r}")
+            k_host = z["k_host"] if "k_host" in z else None
+            v_host = z["v_host"] if "v_host" in z else None
+            ks_host = z["k_scale_host"] if "k_scale_host" in z else None
+            vs_host = z["v_scale_host"] if "v_scale_host" in z else None
+        n_host = int(meta["n_host_pages"])
+        if not self.swap_pool_pages or \
+                self._swap_used + n_host > self.swap_pool_pages:
+            self._m_swap_fallback.inc()
+            return None
+        plan = [("key", bytes.fromhex(val)) if kind == "key"
+                else ("data", int(val)) for kind, val in meta["plan"]]
+        handle = next(self._swap_ids)
+        self._swap[handle] = _SwapEntry(plan, k_host, v_host,
+                                        ks_host, vs_host)
+        self._swap_used += n_host
+        self._m_swap_import.inc(n_host)
+        self._m_swap_pool.set(self._swap_used)
+        return handle
+
     # -- prefix caching (public) -----------------------------------------------
     def lookup_prefix(self, token_ids) -> Tuple[int, List[int]]:
         """Longest page-aligned cached prefix of ``token_ids``: walks
@@ -628,6 +757,8 @@ class PagedKVCache:
                 "swap_pool_used": self._swap_used,
                 "swap_out_pages": int(self._m_swap_out.value),
                 "swap_in_pages": int(self._m_swap_in.value),
+                "swap_exported_pages": int(self._m_swap_export.value),
+                "swap_imported_pages": int(self._m_swap_import.value),
                 "swap_fallbacks": int(self._m_swap_fallback.value)}
 
     # -- device-side ops -------------------------------------------------------

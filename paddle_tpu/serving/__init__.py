@@ -1,7 +1,7 @@
 """``paddle_tpu.serving`` — the runtime between user traffic and the
 ``LLMEngine``.
 
-Three layers, composable bottom-up:
+Layers, composable bottom-up:
 
 * ``Scheduler`` — continuous-batching loop over ONE engine: bounded
   priority queue, capacity-checked admission (a full KV cache queues
@@ -10,25 +10,46 @@ Three layers, composable bottom-up:
   the host pool or recomputes at resume, tokens stay bit-identical),
   opt-in bin-packing admission around a blocked head with an aging
   starvation bound, deadlines / max-queue-time with deadline-miss
-  accounting, load shedding (``RejectedError``), cancellation, and
-  graceful drain.  Adds policy, never math: tokens are bit-identical
-  to driving the engine directly and ``prefill_compiles() == 1``
-  survives.
-* ``ReplicaRouter`` — least-loaded routing across N scheduler-wrapped
-  replicas with per-replica circuit breaking, retry-with-backoff
-  failover, and a fault-injection hook.
+  accounting, load shedding (``RejectedError``), cancellation,
+  graceful drain, and per-request MIGRATION (``migrate_out`` /
+  ``migrate_in`` move a live request — KV swap state included —
+  between schedulers).  Adds policy, never math: tokens are
+  bit-identical to driving the engine directly and
+  ``prefill_compiles() == 1`` survives.
+* ``ReplicaRouter`` — least-loaded routing across N replicas
+  (in-process schedulers or remote backends) with per-replica circuit
+  breaking, retry-with-backoff failover, dead-replica EJECTION with
+  requeue, KV-migrating ``drain_replica``, and a fault-injection
+  hook.
+* ``RemoteReplica`` / ``HealthProber`` (serving/transport.py) — the
+  multi-host tier: an HTTP client adapter that drives a per-host
+  backend through the same duck-typed replica surface (per-call
+  timeouts, bounded backoff + jitter, idempotent rid-keyed
+  resubmission), and an active prober that feeds the router's
+  circuit breaker — slow opens the circuit, dead ejects + requeues.
+* ``Fault`` / ``FaultPlan`` (serving/faults.py) — structured chaos:
+  scheduled refuse / timeout / slow / disconnect / crash injections
+  at the transport seam (and, via ``router_hook``, the router seam).
 * ``HTTPFrontend`` / ``start_http_frontend`` — stdlib streaming HTTP:
-  ``POST /v1/completions`` (chunked per-step token streaming),
-  ``GET /healthz``, ``GET /metrics`` (Prometheus text via the
-  observability registry).
+  ``POST /v1/completions`` (chunked per-step token streaming), the
+  ``/v1/*`` control plane the remote transport drives,
+  ``GET /healthz`` (503 when draining/wedged), ``GET /metrics``
+  (Prometheus text via the observability registry).
 
-All three report through the process-global ``MetricRegistry``
+All layers report through the process-global ``MetricRegistry``
 (queue-wait histogram, shed/abort/deadline-miss/retry counters,
-per-replica load gauges) — one ``/metrics`` scrape covers the stack.
+per-replica load gauges, transport call/error counters, probe
+outcomes, migration counters) — one ``/metrics`` scrape covers the
+stack.
 """
 from .scheduler import RejectedError, ScheduledRequest, Scheduler
 from .router import ReplicaRouter
 from .server import HTTPFrontend, start_http_frontend
+from .transport import (HealthProber, RemoteReplica, TransportError,
+                        TransportTimeout)
+from .faults import Fault, FaultInjected, FaultPlan
 
 __all__ = ["Scheduler", "ScheduledRequest", "RejectedError",
-           "ReplicaRouter", "HTTPFrontend", "start_http_frontend"]
+           "ReplicaRouter", "HTTPFrontend", "start_http_frontend",
+           "RemoteReplica", "HealthProber", "TransportError",
+           "TransportTimeout", "Fault", "FaultPlan", "FaultInjected"]

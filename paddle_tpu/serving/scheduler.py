@@ -86,6 +86,7 @@ SUSPENDED = "suspended"      # preempted: in the queue, tokens so far kept
 FINISHED = "finished"
 CANCELLED = "cancelled"
 SHED = "shed"
+MIGRATED = "migrated"        # exported to another replica (terminal HERE)
 
 
 class RejectedError(UnavailableError):
@@ -238,6 +239,14 @@ class Scheduler:
                 "serving_sched_time_preempted_seconds",
                 "Wall time a preempted request spent suspended before "
                 "resuming.", lbl, buckets=_QWAIT_BUCKETS).labels(sid),
+            "migrated_out": reg.counter(
+                "serving_sched_migrated_out_total",
+                "Requests exported to another replica "
+                "(migrate_out).", lbl).labels(sid),
+            "migrated_in": reg.counter(
+                "serving_sched_migrated_in_total",
+                "Requests adopted from another replica "
+                "(migrate_in).", lbl).labels(sid),
         }
 
     def _shed_inc(self, reason: str):
@@ -394,11 +403,186 @@ class Scheduler:
         with self._lock:
             self._draining = True
 
+    def resume_admission(self) -> None:
+        """Accept submissions again — closes a TEMPORARY drain (a
+        rebalancing migration, a suspected-bad host that probed
+        healthy) without rebuilding the scheduler."""
+        with self._lock:
+            self._draining = False
+
     def drain(self) -> None:
         """Graceful shutdown: refuse new submissions, then finish
         every queued and active request."""
         self.stop_admission()
         self.run_until_idle()
+
+    # -- control surface (router / remote transport) ---------------------------
+    def load(self) -> int:
+        """Waiting + suspended + active requests — the least-loaded
+        routing key.  Suspended requests count: they hold no device
+        pages right now but WILL resume and reclaim capacity, so a
+        replica thrashing on preemption must look loaded."""
+        with self._lock:
+            return (self._n_waiting + self._n_suspended +
+                    len(self.engine._active))
+
+    def health(self, timeout: Optional[float] = None) -> dict:
+        """Liveness answer the prober consumes — in-process replicas
+        are reachable by construction, so only the draining state
+        matters (``timeout`` exists for signature parity with the
+        remote adapter)."""
+        with self._lock:
+            return {"status": "draining" if self._draining else "ok",
+                    "waiting": self._n_waiting}
+
+    def knows(self, rid) -> bool:
+        """True while ``rid`` has a record here (any state) — the
+        idempotent-resubmission check: a retried submit for a known
+        rid must ack, not double-admit."""
+        with self._lock:
+            return rid in self._reqs
+
+    def snapshot_requests(self, rids) -> Dict[object, dict]:
+        """Poll view for the remote transport: per rid, its state and
+        FULL token list so far (the client diffs against what it has
+        already delivered).  Unknown rids answer ``state="unknown"``
+        instead of raising — a poller racing retirement is normal."""
+        out: Dict[object, dict] = {}
+        with self._lock:
+            for rid in rids:
+                rec = self._reqs.get(rid)
+                if rec is None:
+                    out[rid] = {"state": "unknown", "tokens": []}
+                else:
+                    out[rid] = {"state": rec.state,
+                                "tokens": list(rec.tokens),
+                                "deadline_missed": rec.deadline_missed,
+                                "shed_reason": rec.shed_reason}
+        return out
+
+    # -- migration (KV-migrating drain / rebalance) ----------------------------
+    def migrate_out(self, rid) -> Optional[dict]:
+        """Export one live request as a migration package for another
+        replica's ``migrate_in``: WAITING requests travel as policy
+        only (prompt + limits — nothing computed yet), ACTIVE ones are
+        suspended first (KV swaps to the host pool or arms the
+        recompute path), and SUSPENDED ones ship their swap entry
+        serialized portably.  Deadlines re-base: the package carries
+        REMAINING seconds, so differing host clocks cannot corrupt
+        them.  The record leaves this scheduler (state ``migrated``).
+
+        A rid with a cancel pending resolves the cancel instead and
+        returns ``None`` — the client asked for termination, not a new
+        home.  Call from the stepping thread (engine state moves)."""
+        events: List = []
+        pkg = None
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            rec = self._reqs[rid]
+            enforce(rec.state in (WAITING, ACTIVE, SUSPENDED),
+                    f"request {rid!r} is {rec.state} — only live "
+                    f"requests migrate")
+            if rid in self._pending_abort:
+                self._process_aborts(events)
+            else:
+                now = self._clock()
+                pkg = {"rid": rid, "priority": rec.priority,
+                       "deadline_remaining":
+                           None if rec.deadline is None
+                           else rec.deadline - now,
+                       "on_event": rec.on_event}
+                if rec.state == WAITING:
+                    pkg.update({
+                        "admitted": False, "prompt": list(rec.prompt),
+                        "tokens": [], "max_new": rec.max_new,
+                        "eos": rec.eos, "swap": None,
+                        "max_queue_time_remaining":
+                            None if rec.max_queue_time is None
+                            else rec.max_queue_time
+                            - (now - rec.submit_t)})
+                    self._n_waiting -= 1
+                else:
+                    if rec.state == ACTIVE:
+                        self.engine.suspend(rid)
+                    else:
+                        self._n_suspended -= 1
+                    epkg = self.engine.export_request(rid)
+                    pkg.update({
+                        "admitted": True, "prompt": epkg["prompt"],
+                        "tokens": epkg["out"],
+                        "max_new": epkg["max_new"], "eos": epkg["eos"],
+                        "swap": epkg["swap"],
+                        "max_queue_time_remaining": None})
+                rec.state = MIGRATED
+                del self._reqs[rid]
+                if self._metrics is not None:
+                    self._metrics["migrated_out"].inc()
+                self._set_waiting_gauge()
+        self._dispatch(events)
+        return pkg
+
+    def migrate_in(self, pkg: dict,
+                   on_event: Optional[Callable[[dict], None]] = None):
+        """Adopt a migration package.  Admitted requests re-enter as
+        SUSPENDED at their original priority (they resume through the
+        normal capacity-checked admission path — swap-in when the blob
+        fits this cache's pool, recompute otherwise, bit-identical
+        either way); never-admitted ones re-enter WAITING and are
+        subject to the queue bound like any submit.  Raises
+        ``RejectedError`` when draining or (waiting only) the queue is
+        full, and engine limit/geometry errors propagate — the caller
+        tries another replica.  Returns the rid."""
+        rid = pkg["rid"]
+        now = self._clock()
+        events: List = []
+        with self._lock:
+            enforce(rid not in self._reqs,
+                    f"duplicate request id {rid!r}")
+            if self._draining:
+                self._shed_inc("draining")
+                raise RejectedError(
+                    f"scheduler is draining; migrated request {rid!r} "
+                    f"rejected")
+            dl = pkg.get("deadline_remaining")
+            mqt = pkg.get("max_queue_time_remaining")
+            rec = ScheduledRequest(
+                rid, pkg["prompt"], pkg["max_new"], pkg["eos"],
+                pkg.get("priority", 0),
+                None if dl is None else now + dl, mqt, now,
+                on_event if on_event is not None
+                else pkg.get("on_event"), next(self._seq))
+            if pkg["admitted"]:
+                self.engine.import_request(
+                    {"rid": rid, "prompt": pkg["prompt"],
+                     "out": pkg["tokens"], "max_new": pkg["max_new"],
+                     "eos": pkg["eos"], "swap": pkg.get("swap")})
+                rec.tokens = list(pkg["tokens"])
+                rec.state = SUSPENDED
+                rec.preempt_t = now
+                self._n_suspended += 1
+            else:
+                if self._n_waiting >= self.max_queue:
+                    self._shed_inc("queue_full")
+                    raise RejectedError(
+                        f"waiting queue full ({self.max_queue}); "
+                        f"migrated request {rid!r} shed")
+                self._n_waiting += 1
+            self._reqs[rid] = rec
+            heapq.heappush(self._heap, rec)
+            rec.in_heap = True
+            if self._metrics is not None:
+                self._metrics["migrated_in"].inc()
+            self._set_waiting_gauge()
+            # tokens the source computed but never delivered to the
+            # stream (a remote source can run ahead of its polls):
+            # catch the stream up before new tokens arrive
+            delivered = pkg.get("delivered", len(rec.tokens))
+            if rec.tokens[delivered:]:
+                self._event(events, rec,
+                            {"type": "tokens", "rid": rid,
+                             "tokens": list(rec.tokens[delivered:])})
+        self._dispatch(events)
+        return rid
 
     # -- results ---------------------------------------------------------------
     def status(self, rid) -> str:
@@ -470,6 +654,8 @@ class Scheduler:
                     "deadline_miss": int(m["deadline_miss"].value),
                     "preempted": int(m["preempted"].value),
                     "packed_admissions": int(m["packed"].value),
+                    "migrated_out": int(m["migrated_out"].value),
+                    "migrated_in": int(m["migrated_in"].value),
                     "time_preempted_seconds":
                         m["time_preempted"]._snapshot_value(),
                     "queue_wait_seconds":

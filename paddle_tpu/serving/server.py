@@ -1,8 +1,10 @@
-"""Streaming HTTP frontend over a ``Scheduler`` or ``ReplicaRouter``.
+"""Streaming HTTP frontend over a ``Scheduler`` or ``ReplicaRouter``
+— and the per-host BACKEND the remote-replica transport drives.
 
 Stdlib-only (``http.server``), mirroring
 ``observability.exposition.MetricsServer``'s dependency discipline.
-Three endpoints:
+
+Data-plane endpoints (end users):
 
 * ``POST /v1/completions`` — JSON body
   ``{"prompt": [token ids], "max_tokens": N, "stream": true,
@@ -13,19 +15,49 @@ Three endpoints:
   are produced, then a terminal ``{"id", "done": true, "state",
   "n_tokens", "deadline_missed"}`` line.  ``"stream": false``
   returns one JSON object with the full token list.  Overload maps to
-  HTTP: a shed request is ``429``, an invalid one ``400``.
-* ``GET /healthz`` — liveness + queue/replica summary.
-* ``GET /metrics`` — Prometheus text via the observability
-  registry's ``expose_text`` (same format the standalone
-  ``start_metrics_server`` serves).
+  HTTP: a shed request is ``429``, an invalid one ``400``, an
+  oversized body ``413``.  Unless the body names its own
+  ``deadline``, the frontend's ``request_timeout`` is submitted as
+  the scheduler deadline — a client that gave up cannot leave its
+  request decoding (a still-waiting request sheds at the moment the
+  client stops listening).
+
+Control-plane endpoints (``RemoteReplica`` in
+serving/transport.py — non-blocking, JSON in/out, no long-lived
+connections):
+
+* ``POST /v1/submit`` — enqueue without streaming; IDEMPOTENT by
+  rid: a rid the target already knows acks ``{"accepted": true,
+  "duplicate": true}`` instead of double-admitting (the retry-after-
+  lost-reply case).
+* ``POST /v1/poll`` — ``{"ids": [...]}`` → per-rid state + full
+  token list so far (the client diffs); unknown rids answer
+  ``state="unknown"``.
+* ``POST /v1/cancel`` / ``/v1/result`` / ``/v1/pop_result`` /
+  ``/v1/forget`` — the scheduler surface, 429 for shed results,
+  400 for contract violations.
+* ``POST /v1/drain`` — stop admission (healthz turns 503).
+* ``POST /v1/migrate_out`` / ``/v1/migrate_in`` — the KV-migration
+  hop: packages travel as JSON with the swap blob base64-encoded;
+  both run ON THE LOOP THREAD (engine state moves) via the command
+  queue, and ``migrate_in`` is idempotent by rid like submit.
+* ``GET /v1/load`` — the least-loaded routing key, cheap.
+* ``GET /v1/stats`` — the target's full ``metrics_snapshot()``.
+* ``GET /healthz`` — 200 while serving; **503** with a reason body
+  when the scheduler is DRAINING or the loop thread died (WEDGED) —
+  the prober and any LB act on the status code alone.
+* ``GET /metrics`` — Prometheus text via the observability registry.
 
 The frontend owns the scheduling loop: a daemon thread drives
 ``target.step()`` whenever work is pending, so handler threads only
 submit and wait on their per-request event queues — all engine work
-stays on ONE thread, as the scheduler's contract requires.
+stays on ONE thread, as the scheduler's contract requires.  Handlers
+that must touch engine state (migration) marshal closures onto that
+thread through ``_on_loop``.
 """
 from __future__ import annotations
 
+import base64
 import json
 import queue
 import threading
@@ -34,7 +66,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from ..common.errors import EnforceError
+from ..common.errors import EnforceError, UnavailableError
 from ..observability import get_registry
 from ..observability.exposition import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from .scheduler import RejectedError
@@ -47,19 +79,25 @@ _TERMINAL = ("finished", "cancelled", "shed")
 class HTTPFrontend:
     """Serving endpoint handle: ``.port`` / ``.url``, ``.shutdown()``.
     ``target`` is anything with the scheduler request surface
-    (``submit/cancel/pop_result/step/busy/metrics_snapshot``) — a
-    ``Scheduler`` or a ``ReplicaRouter``."""
+    (``submit/cancel/pop_result/step/busy/metrics_snapshot`` and, for
+    the control plane, ``knows/snapshot_requests/load/migrate_*``) —
+    a ``Scheduler`` or a ``ReplicaRouter``.  ``max_body_bytes`` caps
+    request bodies (oversized → 413) so a hostile Content-Length
+    cannot balloon memory."""
 
     def __init__(self, target, addr: str = "127.0.0.1", port: int = 0,
                  registry=None, default_max_tokens: int = 64,
                  request_timeout: float = 120.0,
-                 poll_interval: float = 0.002):
+                 poll_interval: float = 0.002,
+                 max_body_bytes: int = 4 << 20):
         self.target = target
         self.registry = registry or get_registry()
         self.default_max_tokens = default_max_tokens
         self.request_timeout = request_timeout
         self.poll_interval = poll_interval
+        self.max_body_bytes = int(max_body_bytes)
         self._stop = threading.Event()
+        self._cmds: "queue.Queue[tuple]" = queue.Queue()
         frontend = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -76,10 +114,35 @@ class HTTPFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _read_json(self) -> Optional[dict]:
+                """Parse the JSON body under the size cap; on any
+                violation the error response is already written and
+                ``None`` returns (the caller just stops)."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._json(400, {"error": "invalid Content-Length"})
+                    return None
+                if n < 0:
+                    self._json(400, {"error": "invalid Content-Length"})
+                    return None
+                if n > frontend.max_body_bytes:
+                    self._json(413, {
+                        "error": f"request body of {n} bytes exceeds "
+                                 f"the {frontend.max_body_bytes}-byte "
+                                 f"limit"})
+                    return None
+                try:
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {"error": f"bad JSON body: {e}"})
+                    return None
+
             def do_GET(self):
                 path = self.path.split("?")[0]
                 if path == "/healthz":
-                    self._json(200, frontend._health())
+                    code, body = frontend._health()
+                    self._json(code, body)
                 elif path == "/metrics":
                     body = frontend.registry.expose_text().encode(
                         "utf-8")
@@ -89,21 +152,37 @@ class HTTPFrontend:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/v1/load":
+                    frontend._guarded(self, lambda: {
+                        "load": frontend.target.load()})
+                elif path == "/v1/stats":
+                    frontend._guarded(
+                        self, frontend.target.metrics_snapshot)
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
             def do_POST(self):
                 path = self.path.split("?")[0]
-                if path != "/v1/completions":
+                routes = {
+                    "/v1/completions": frontend._completions,
+                    "/v1/submit": frontend._cp_submit,
+                    "/v1/cancel": frontend._cp_cancel,
+                    "/v1/poll": frontend._cp_poll,
+                    "/v1/result": frontend._cp_result,
+                    "/v1/pop_result": frontend._cp_pop_result,
+                    "/v1/forget": frontend._cp_forget,
+                    "/v1/drain": frontend._cp_drain,
+                    "/v1/migrate_out": frontend._cp_migrate_out,
+                    "/v1/migrate_in": frontend._cp_migrate_in,
+                }
+                fn = routes.get(path)
+                if fn is None:
                     self._json(404, {"error": f"no route {path}"})
                     return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                except (ValueError, json.JSONDecodeError) as e:
-                    self._json(400, {"error": f"bad JSON body: {e}"})
+                body = self._read_json()
+                if body is None:
                     return
-                frontend._completions(self, body)
+                fn(self, body)
 
         self._httpd = ThreadingHTTPServer((addr, port), Handler)
         self._httpd.daemon_threads = True
@@ -125,10 +204,42 @@ class HTTPFrontend:
     # -- the scheduling loop ---------------------------------------------------
     def _loop(self):
         while not self._stop.is_set():
+            self._run_cmds()
             if self.target.busy():
                 self.target.step()
             else:
                 self._stop.wait(self.poll_interval)
+        self._run_cmds()                      # unblock late callers
+
+    def _run_cmds(self):
+        """Execute marshaled closures (engine-state work from handler
+        threads) on the loop thread."""
+        while True:
+            try:
+                fn, box, done = self._cmds.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box[0] = fn()
+            except BaseException as e:
+                box[1] = e
+            done.set()
+
+    def _on_loop(self, fn, timeout: float = 60.0):
+        """Run ``fn`` on the scheduling loop thread and return its
+        result — the engine-state marshaling primitive (the scheduler
+        contract: ONE thread owns all engine work)."""
+        if not self._loop_thread.is_alive():
+            raise UnavailableError(
+                "scheduler loop thread is not running")
+        box = [None, None]
+        done = threading.Event()
+        self._cmds.put((fn, box, done))
+        if not done.wait(timeout):
+            raise UnavailableError("loop-thread command timed out")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
 
     def shutdown(self, drain: bool = True):
         """Stop serving.  ``drain=True`` finishes in-flight requests
@@ -142,19 +253,55 @@ class HTTPFrontend:
         if drain:
             self.target.drain()
 
-    # -- handlers --------------------------------------------------------------
-    def _health(self) -> dict:
-        snap = self.target.metrics_snapshot()
+    def kill(self):
+        """Chaos hook: die NOW — close the socket and stop the loop
+        with no drain and no handshakes, the closest an in-process
+        server gets to a host crash.  Subsequent connections are
+        refused; in-flight state is simply gone."""
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+        self._http_thread.join(timeout=10)
+        self._loop_thread.join(timeout=10)
+
+    # -- handlers: health ------------------------------------------------------
+    def _health(self) -> tuple:
+        """(status code, body): 200 only while this backend can take
+        and make progress on work — 503 ``draining`` once admission
+        stopped, 503 ``wedged`` when the scheduling loop thread died
+        (alive socket, dead engine: the worst failure to hide)."""
+        if not self._loop_thread.is_alive() and not self._stop.is_set():
+            return 503, {"status": "wedged",
+                         "reason": "scheduler loop thread died — "
+                                   "accepting connections but not "
+                                   "decoding"}
+        try:
+            snap = self.target.metrics_snapshot()
+        except Exception as e:
+            return 503, {"status": "wedged",
+                         "reason": f"target snapshot failed: {e}"}
         out = {"status": "ok"}
+        draining = bool(snap.get("draining", False))
         if "replicas" in snap:                # router target
             out["replicas"] = [
                 {"replica": r["replica"], "healthy": r["healthy"],
                  "load": r["load"]} for r in snap["replicas"]]
+            scheds = [r.get("sched", {}) for r in snap["replicas"]]
+            draining = bool(scheds) and all(
+                s.get("draining", False) for s in scheds)
         else:
             out["waiting"] = snap.get("waiting", 0)
-            out["draining"] = snap.get("draining", False)
-        return out
+            out["draining"] = draining
+        if draining:
+            return 503, {**out, "status": "draining",
+                         "reason": "scheduler is draining; new work "
+                                   "is refused"}
+        return 200, out
 
+    # -- handlers: data plane --------------------------------------------------
     def _completions(self, handler, body: dict):
         prompt = body.get("prompt")
         if not isinstance(prompt, list) or \
@@ -173,6 +320,11 @@ class HTTPFrontend:
             kw["eos_token_id"] = int(body["eos_token_id"])
         if body.get("deadline") is not None:
             kw["deadline"] = float(body["deadline"])
+        elif self.request_timeout is not None:
+            # a client that times out stops listening at
+            # request_timeout — submit that as the scheduler deadline
+            # so its request cannot keep decoding for nobody
+            kw["deadline"] = float(self.request_timeout)
         if body.get("max_queue_time") is not None:
             kw["max_queue_time"] = float(body["max_queue_time"])
         try:
@@ -196,14 +348,15 @@ class HTTPFrontend:
         disconnect): cancel if still running, then drop the record so
         a long-lived server's memory stays bounded."""
         try:
-            if self.target.status(rid) in ("waiting", "active"):
+            if self.target.status(rid) in ("waiting", "active",
+                                           "suspended"):
                 self.target.cancel(rid)
                 # an active-request cancel lands at the loop thread's
                 # next step(); wait it out before popping
                 deadline = time.monotonic() + 5.0
                 while time.monotonic() < deadline and \
-                        self.target.status(rid) in ("waiting",
-                                                    "active"):
+                        self.target.status(rid) in ("waiting", "active",
+                                                    "suspended"):
                     time.sleep(self.poll_interval)
             self.target.forget(rid)
         except Exception:
@@ -271,6 +424,132 @@ class HTTPFrontend:
                     "deadline_missed": ev.get("deadline_missed",
                                               False)})
                 return
+
+    # -- handlers: control plane (the remote-replica surface) ------------------
+    def _guarded(self, handler, fn):
+        """Run ``fn`` and map the scheduler error vocabulary onto
+        HTTP: shed → 429, contract violation → 400, anything else →
+        500 (retryable transport-side)."""
+        try:
+            out = fn()
+        except RejectedError as e:
+            handler._json(429, {"error": str(e)})
+        except EnforceError as e:
+            handler._json(400, {"error": str(e)})
+        except Exception as e:
+            handler._json(500, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            handler._json(200, out if isinstance(out, dict) else {})
+
+    def _cp_submit(self, handler, body: dict):
+        rid = body.get("id")
+        prompt = body.get("prompt")
+        if not rid or not isinstance(prompt, list) or \
+                not all(isinstance(t, int) for t in prompt):
+            handler._json(400, {"error": "need 'id' and 'prompt' "
+                                         "(list of token ids)"})
+            return
+        kw = dict(max_new_tokens=int(body.get("max_tokens",
+                                              self.default_max_tokens)),
+                  priority=int(body.get("priority", 0)))
+        if body.get("eos_token_id") is not None:
+            kw["eos_token_id"] = int(body["eos_token_id"])
+        if body.get("deadline") is not None:
+            kw["deadline"] = float(body["deadline"])
+        if body.get("max_queue_time") is not None:
+            kw["max_queue_time"] = float(body["max_queue_time"])
+
+        def submit():
+            if self.target.knows(rid):
+                # idempotent resubmission: the first attempt WAS
+                # admitted, its reply was lost — ack, don't double-run
+                return {"id": rid, "accepted": True, "duplicate": True}
+            try:
+                self.target.submit(rid, prompt, **kw)
+            except EnforceError:
+                if self.target.knows(rid):    # lost the knows() race
+                    return {"id": rid, "accepted": True,
+                            "duplicate": True}
+                raise
+            return {"id": rid, "accepted": True}
+
+        self._guarded(handler, submit)
+
+    def _cp_cancel(self, handler, body: dict):
+        rid = body.get("id")
+        self._guarded(handler, lambda: {
+            "id": rid, "cancelled": bool(self.target.cancel(rid))})
+
+    def _cp_poll(self, handler, body: dict):
+        ids = body.get("ids", [])
+        self._guarded(handler, lambda: {
+            "requests": self.target.snapshot_requests(ids)})
+
+    def _cp_result(self, handler, body: dict):
+        rid = body.get("id")
+        self._guarded(handler, lambda: {
+            "id": rid, "tokens": self.target.result(rid)})
+
+    def _cp_pop_result(self, handler, body: dict):
+        rid = body.get("id")
+        self._guarded(handler, lambda: {
+            "id": rid, "tokens": self.target.pop_result(rid)})
+
+    def _cp_forget(self, handler, body: dict):
+        rid = body.get("id")
+
+        def forget():
+            self.target.forget(rid)
+            return {"id": rid}
+
+        self._guarded(handler, forget)
+
+    def _cp_drain(self, handler, body: dict):
+        resume = body.get("mode") == "resume"
+
+        def drain():
+            if resume:
+                self.target.resume_admission()
+            else:
+                self.target.stop_admission()
+            return {"draining": not resume}
+
+        self._guarded(handler, drain)
+
+    def _cp_migrate_out(self, handler, body: dict):
+        rid = body.get("id")
+
+        def migrate():
+            pkg = self._on_loop(lambda: self.target.migrate_out(rid))
+            if pkg is None:
+                return {"package": None}
+            pkg.pop("on_event", None)         # never crosses the wire
+            if pkg.get("swap") is not None:
+                pkg["swap"] = base64.b64encode(
+                    pkg["swap"]).decode("ascii")
+            return {"package": pkg}
+
+        self._guarded(handler, migrate)
+
+    def _cp_migrate_in(self, handler, body: dict):
+        pkg = body.get("package")
+        if not isinstance(pkg, dict) or "rid" not in pkg:
+            handler._json(400, {"error": "need a 'package' with a "
+                                         "'rid'"})
+            return
+        pkg = dict(pkg)
+        pkg.pop("on_event", None)
+        if pkg.get("swap") is not None:
+            pkg["swap"] = base64.b64decode(pkg["swap"])
+
+        def migrate():
+            if self.target.knows(pkg["rid"]):
+                return {"id": pkg["rid"], "accepted": True,
+                        "duplicate": True}
+            self._on_loop(lambda: self.target.migrate_in(pkg))
+            return {"id": pkg["rid"], "accepted": True}
+
+        self._guarded(handler, migrate)
 
 
 def start_http_frontend(target, addr: str = "127.0.0.1",

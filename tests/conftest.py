@@ -1,5 +1,11 @@
 """tests/ conftest: fleet/mesh state is torn down after every test so
-topology-building tests can't leak meshes into each other."""
+topology-building tests can't leak meshes into each other, and a
+thread-leak guard keeps the serving tier's HTTP servers / probers /
+loop threads from outliving their test (a leaked loop thread is how a
+tier-1 run hangs on a 1-core box)."""
+import threading
+import time
+
 import pytest
 
 
@@ -15,3 +21,29 @@ def _reset_fleet_state():
     yield
     from paddle_tpu.distributed import fleet
     fleet.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks():
+    """Assert no non-daemon thread — and no paddle-tpu-named serving
+    thread (HTTP server, scheduler loop, prober), daemon or not —
+    survives the test.  Leaked threads are given a short grace period
+    to finish joining (ThreadingHTTPServer handler threads wind down
+    asynchronously after shutdown())."""
+    before = {t.ident for t in threading.enumerate()}
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.ident not in before and t.is_alive() and
+                (not t.daemon or t.name.startswith("paddle-tpu-"))]
+
+    deadline = time.monotonic() + 10.0
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    left = leaked()
+    assert not left, (
+        f"threads leaked past the test: "
+        f"{[(t.name, 'daemon' if t.daemon else 'non-daemon') for t in left]} "
+        f"— shut down frontends/probers (fe.shutdown(), prober.stop()) "
+        f"before returning")
