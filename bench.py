@@ -20,6 +20,7 @@ Qwen2-MoE, decode tokens/sec) and prints one JSON line per row.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -1296,6 +1297,87 @@ def jnp_bf16():
     return jnp.bfloat16
 
 
+def bench_ckpt():
+    """Crash-safe training row (ISSUE 7): checkpoint overhead on the
+    compiled training step — atomic staging commit + per-chunk sha256,
+    saved every K steps through a CheckpointManager.  Headline value:
+    async-save wall overhead vs a no-checkpoint run of the same steps
+    (1.0 = free); vs_baseline is the SYNC overhead on the same schedule
+    — the gap is what the bounded write-behind queue buys.  The bench
+    asserts the last checkpoint validates (committed manifest, sha256)
+    so the speed is never bought with a torn save."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import validate_checkpoint
+    from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+    from paddle_tpu.jit.train import CompiledTrainStep
+    from paddle_tpu.models.gpt import (GPTForCausalLM,
+                                       GPTPretrainingCriterion,
+                                       gpt2_tiny_config)
+
+    _, kind, peak, hbm, on_tpu = _device()
+    cfg = gpt2_tiny_config()
+    rng = np.random.default_rng(0)
+    ids = ((np.arange(32)[None, :] + rng.integers(0, 8, (8, 1))) % 32
+           ).astype(np.int32)
+    batch = {"x": ids[:, :-1], "y": ids[:, 1:].astype(np.int64)}
+    steps, save_every = 12, 3
+
+    def make_step():
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01)
+        return CompiledTrainStep(
+            model, lambda m, b: crit(m(b["x"]), b["y"]), opt, seed=0)
+
+    def run(mode, root):
+        step = make_step()
+        manager = None if mode == "none" else CheckpointManager(
+            root, keep_last_n=2, async_save=(mode == "async"))
+        loss = step(batch)                       # compile outside timing
+        import jax
+        jax.device_get(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = step(batch)
+            if manager is not None and (i + 1) % save_every == 0:
+                manager.save(step, i + 1)
+        if manager is not None:
+            manager.wait()                       # async saves must land
+        jax.device_get(loss)
+        wall = time.perf_counter() - t0
+        if manager is not None:
+            validate_checkpoint(manager.step_dir(steps))
+        return wall
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        run("none", root)                        # warm the whole path
+        base = run("none", root)
+        sync_w = run("sync", os.path.join(root, "s"))
+        async_w = run("async", os.path.join(root, "a"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    from paddle_tpu.observability import get_registry
+    hist = get_registry().get("ckpt_save_seconds")
+    means = {m: round(hist.labels(m).mean, 4)
+             for m in ("sync", "async")} if hist is not None else {}
+    return {
+        "metric": "ckpt_async_step_overhead",
+        "value": round(async_w / base, 4),
+        "unit": "x wall vs no-checkpoint run (1.0 = free)",
+        "vs_baseline": round(sync_w / base, 4),
+        "extra": {"device_kind": kind, "steps": steps,
+                  "save_every": save_every,
+                  "wall_none_s": round(base, 4),
+                  "wall_sync_s": round(sync_w, 4),
+                  "wall_async_s": round(async_w, 4),
+                  "save_seconds_mean": means}}
+
+
 def bench_longseq():
     """Long-context row: 32k-token sequences on ONE chip (flash attention
     + selective remat + fused CE keep the S^2 and vocab terms off HBM).
@@ -1408,6 +1490,7 @@ def main():
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),
                ("bench_serving_drain", bench_serving_drain),
+               ("bench_ckpt", bench_ckpt),
                ("bench_engine_window", bench_engine_window),
                ("bench_longseq", bench_longseq)]
         failed = 0

@@ -19,6 +19,7 @@ __all__ = [
     "UnimplementedError",
     "UnavailableError",
     "ExecutionTimeoutError",
+    "CorruptCheckpointError",
     "enforce",
     "enforce_eq",
     "enforce_gt",
@@ -67,6 +68,15 @@ class UnavailableError(EnforceError):
 
 class ExecutionTimeoutError(EnforceError):
     category = "ExecutionTimeout"
+
+
+class CorruptCheckpointError(EnforceError):
+    """A checkpoint directory failed integrity checks: missing/torn
+    manifest, uncommitted staging state, missing chunk files, or a
+    per-chunk sha256 mismatch.  Callers (CheckpointManager.restore,
+    auto_resume) catch this to fall back to the previous valid
+    checkpoint."""
+    category = "CorruptCheckpoint"
 
 
 def enforce(cond, msg: str, error_cls=InvalidArgumentError):

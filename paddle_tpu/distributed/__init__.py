@@ -50,7 +50,10 @@ from .collective import (
     stream,
     wait,
 )
-from .checkpoint import load_state_dict, save_state_dict
+from . import ckpt_manager
+from .checkpoint import (CorruptCheckpointError, load_state_dict,
+                         save_state_dict, validate_checkpoint)
+from .ckpt_manager import CheckpointManager
 from .env import ParallelEnv, get_rank, get_world_size, spawn
 from .fleet import fleet
 from .strategy import DistributedStrategy

@@ -1,4 +1,4 @@
-"""Distributed sharded checkpoint with reshard-on-load.
+"""Distributed sharded checkpoint with reshard-on-load and atomic commit.
 
 Reference parity: python/paddle/distributed/checkpoint/
 (``save_state_dict`` / ``load_state_dict`` — per-rank shard files plus a
@@ -7,8 +7,8 @@ meshes/degrees; SURVEY.md §5 Checkpoint/resume).
 
 TPU-native design: a checkpoint is a directory of ``.npy`` chunk files —
 one per unique (non-replica) shard of every array in the state pytree —
-plus ``metadata.json`` recording each array's global shape, dtype, and
-the index box every chunk covers.  Saving walks
+plus ``metadata.json`` recording each array's global shape, dtype, the
+index box every chunk covers, and each chunk's sha256.  Saving walks
 ``jax.Array.addressable_shards`` and writes only ``replica_id == 0``
 shards (so replicated axes are stored once and every multi-host process
 writes a disjoint set of files); loading rebuilds each array with
@@ -16,30 +16,287 @@ writes a disjoint set of files); loading rebuilds each array with
 only the chunk bytes that overlap each requested index box (chunks are
 memory-mapped, so resharding from an (8-way) checkpoint onto 1 device or
 any other mesh never materializes more than the requested slices).
-This is the same contract as the reference's load-time reshard
-(per-rank files + metadata → arbitrary target placement), with
-tensorstore's chunked-read role played by mmap'd npy chunks.
+
+Crash safety (the atomic-commit contract):
+
+- Every save builds the whole checkpoint in a ``<path>.tmp-<nonce>``
+  staging directory next to the destination: chunk files (fsync'd), then
+  the manifest carrying ``"committed": true`` plus per-chunk sha256.
+- Fresh destination: commit is ONE ``os.rename(staging, path)`` — a kill
+  at any byte offset leaves either no ``path`` at all (plus an orphaned
+  staging dir that later saves / ``CheckpointManager.gc_stale`` sweep)
+  or the complete committed checkpoint.  A torn checkpoint is never
+  visible under ``path``.
+- Existing destination (re-save in place): the fresh ``data-<nonce>``
+  chunk dir is renamed into ``path`` first, then the manifest is
+  atomically replaced — readers see the OLD complete checkpoint until
+  the manifest swap, never a mix.
+- Load verifies each chunk file's sha256 against the manifest before
+  reading it and raises :class:`CorruptCheckpointError` (typed) on any
+  mismatch/missing file, so bit-rot or a torn write from a pre-atomic
+  writer can't be silently consumed.
+
+Async saves return an :class:`AsyncSaveHandle`; a background-writer
+failure re-raises on ``wait()``/``join()`` and — if never waited — at
+the next ``save_state_dict`` call, and live writer threads are joined at
+interpreter exit.  Failures are never silently dropped.
 """
 from __future__ import annotations
 
+import atexit
 import hashlib
+import io as _io
 import json
 import os
 import re
+import shutil
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import numpy as np
 
-from ..common.errors import enforce
+from ..common.errors import CorruptCheckpointError, enforce
 from ..tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "get_checkpoint_metadata"]
+__all__ = ["save_state_dict", "load_state_dict", "get_checkpoint_metadata",
+           "validate_checkpoint", "AsyncSaveHandle", "CorruptCheckpointError",
+           "ChaosCrash", "set_chaos", "clear_chaos"]
 
 _METADATA = "metadata.json"
-_VERSION = 1
+_VERSION = 2                 # v2: "committed" flag + per-chunk sha256/bytes
+_KNOWN_VERSIONS = (1, 2)     # v1 (pre-atomic) checkpoints still load
+
+
+# ---------------------------------------------------------------------------
+# chaos injection (crash-at-point, used by the trainer chaos harness)
+# ---------------------------------------------------------------------------
+
+class ChaosCrash(RuntimeError):
+    """In-process stand-in for a SIGKILL at a save point (chaos tests)."""
+
+
+_CHAOS_POINTS = ("mid-chunk", "pre-manifest", "pre-rename", "post-commit")
+_chaos_plan: Optional[Dict[str, Any]] = None
+
+
+def set_chaos(point: str, nth: int = 1, mode: str = "raise"):
+    """Arm a crash at the given save point on its ``nth`` visit.
+    ``mode="raise"`` raises :class:`ChaosCrash` (in-process tests);
+    ``mode="exit"`` calls ``os._exit(17)`` (subprocess kill tests).
+    The env var ``PADDLE_TPU_CKPT_CHAOS=point[:nth[:mode]]`` arms the
+    same plan across a process boundary."""
+    global _chaos_plan
+    enforce(point in _CHAOS_POINTS, f"unknown chaos point {point!r}; "
+            f"one of {_CHAOS_POINTS}")
+    _chaos_plan = {"point": point, "n": int(nth), "mode": mode}
+
+
+def clear_chaos():
+    global _chaos_plan
+    _chaos_plan = None
+    os.environ.pop("PADDLE_TPU_CKPT_CHAOS", None)
+
+
+def _chaos_spec() -> Optional[Dict[str, Any]]:
+    global _chaos_plan
+    if _chaos_plan is None:
+        env = os.environ.get("PADDLE_TPU_CKPT_CHAOS")
+        if env:
+            parts = env.split(":")
+            _chaos_plan = {"point": parts[0],
+                           "n": int(parts[1]) if len(parts) > 1 else 1,
+                           "mode": parts[2] if len(parts) > 2 else "exit"}
+    return _chaos_plan
+
+
+def _chaos_hit(point: str) -> bool:
+    plan = _chaos_spec()
+    if plan is None or plan["point"] != point:
+        return False
+    plan["n"] -= 1
+    return plan["n"] <= 0
+
+
+def _chaos_crash(point: str):
+    plan = _chaos_spec()
+    mode = plan["mode"] if plan else "raise"
+    clear_chaos()
+    if mode == "exit":
+        os._exit(17)
+    raise ChaosCrash(f"injected crash at checkpoint save point {point!r}")
+
+
+# ---------------------------------------------------------------------------
+# staging-dir registry (conftest leak guard) + fsync helpers
+# ---------------------------------------------------------------------------
+
+_STAGING_LOCK = threading.Lock()
+_LIVE_STAGING: Set[str] = set()
+
+
+def _track_staging(p: str):
+    with _STAGING_LOCK:
+        _LIVE_STAGING.add(p)
+
+
+def _untrack_staging(p: str):
+    with _STAGING_LOCK:
+        _LIVE_STAGING.discard(p)
+
+
+def staging_dirs_alive() -> List[str]:
+    """Staging dirs created but never committed/GC'd that still exist on
+    disk — the tests/ conftest fails any test that leaves one behind."""
+    with _STAGING_LOCK:
+        return sorted(p for p in _LIVE_STAGING if os.path.isdir(p))
+
+
+def _fsync_dir(d: str):
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = _io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# metrics (observability wiring — lazy so import stays cheap)
+# ---------------------------------------------------------------------------
+
+def _reg():
+    from ..observability import get_registry
+    return get_registry()
+
+
+_SECONDS_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    30.0, 60.0, 300.0)
+
+
+def _save_metrics():
+    reg = _reg()
+    return (reg.histogram("ckpt_save_seconds",
+                          "checkpoint save duration (host->disk flush)",
+                          labelnames=("mode",), buckets=_SECONDS_BUCKETS),
+            reg.counter("ckpt_bytes_written_total",
+                        "checkpoint chunk+manifest bytes flushed to disk"))
+
+
+def _load_metrics():
+    return _reg().histogram("ckpt_load_seconds",
+                            "checkpoint load duration (disk->device)",
+                            buckets=_SECONDS_BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# async save handles — failures must surface, never vanish
+# ---------------------------------------------------------------------------
+
+class AsyncSaveHandle:
+    """Returned by ``save_state_dict(async_save=True)``.
+
+    ``wait(timeout=None)`` joins the background writer and re-raises its
+    exception, if any (every call re-raises until the save is re-tried).
+    ``join`` is an alias so Thread-shaped callers keep working.  A
+    handle whose writer failed and was never waited re-raises at the
+    next ``save_state_dict`` call; live writers are joined at
+    interpreter exit."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.bytes_written = 0
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self._surfaced = False
+
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def exception(self) -> Optional[BaseException]:
+        """The writer's exception (marks it surfaced), or None."""
+        if self._exc is not None:
+            self._surfaced = True
+        return self._exc
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the writer.  Returns False on timeout; raises the
+        writer's exception when the save failed."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                return False
+        _forget_handle(self)
+        if self._exc is not None:
+            self._surfaced = True
+            raise self._exc
+        return True
+
+    def join(self, timeout: Optional[float] = None):
+        self.wait(timeout)
+
+
+_HANDLES_LOCK = threading.Lock()
+_LIVE_HANDLES: Set[AsyncSaveHandle] = set()
+_ATEXIT_ARMED = False
+
+
+def _remember_handle(h: AsyncSaveHandle):
+    global _ATEXIT_ARMED
+    with _HANDLES_LOCK:
+        _LIVE_HANDLES.add(h)
+        if not _ATEXIT_ARMED:
+            _ATEXIT_ARMED = True
+            atexit.register(_join_live_writers)
+
+
+def _forget_handle(h: AsyncSaveHandle):
+    with _HANDLES_LOCK:
+        _LIVE_HANDLES.discard(h)
+
+
+def _surface_failed_async_saves():
+    """Called at every save entry: a finished-but-failed handle nobody
+    waited on re-raises HERE rather than vanishing with its thread."""
+    with _HANDLES_LOCK:
+        handles = list(_LIVE_HANDLES)
+    for h in handles:
+        if not h.done():
+            continue
+        _forget_handle(h)
+        if h._exc is not None and not h._surfaced:
+            h._surfaced = True
+            raise RuntimeError(
+                f"previous async checkpoint save to {h.path!r} failed "
+                f"(surfacing at next save; call handle.wait() to catch "
+                f"it at the save site)") from h._exc
+
+
+def _join_live_writers():
+    """atexit: never let the interpreter tear down under an in-flight
+    checkpoint writer (a half-written staging dir is recoverable, but a
+    silently-truncated flush that LOOKED returned is not)."""
+    with _HANDLES_LOCK:
+        handles = list(_LIVE_HANDLES)
+    for h in handles:
+        if h._thread is not None:
+            h._thread.join(timeout=600.0)
+        if h._exc is not None and not h._surfaced:
+            import sys
+            print(f"paddle_tpu: async checkpoint save to {h.path!r} "
+                  f"failed and was never waited on: {h._exc!r}",
+                  file=sys.stderr)
 
 
 # ---------------------------------------------------------------------------
@@ -118,27 +375,31 @@ def _norm_box(idx: Sequence[slice], shape: Sequence[int]
 
 def save_state_dict(state_dict, path: str, process_group=None,
                     coordinator_rank: int = 0, async_save: bool = False
-                    ) -> Optional[threading.Thread]:
+                    ) -> Optional[AsyncSaveHandle]:
     """Write ``state_dict`` (any pytree of Tensors / jax or numpy arrays /
     scalars / literals) as a sharded checkpoint directory at ``path``.
 
     Each process writes only its own non-replica shards; the coordinator
     writes the manifest.  With ``async_save=True`` the host->disk writes
     happen on a background thread (device->host copies are still taken
-    synchronously so training may mutate/donate the state immediately);
-    the returned Thread can be join()ed.
+    synchronously so training may mutate/donate the state immediately)
+    and an :class:`AsyncSaveHandle` is returned; a writer failure
+    re-raises on ``handle.wait()`` or — unwaited — at the next save.
 
-    Crash safety: every save writes its chunks into a fresh
-    ``data-<nonce>/`` subdirectory and commits by atomically replacing
-    the manifest afterwards, so re-saving into the same path can never
-    mix chunks of two saves under one manifest; a crash mid-save leaves
-    the previous checkpoint fully intact (the orphaned data dir is
-    garbage-collected by the next successful save).  Multi-host callers
-    must call this collectively from the main thread: the save nonce is
-    agreed via a broadcast at entry (which doubles as an entry barrier,
-    invalidating any stale completion markers from interrupted saves).
+    Crash safety: the whole checkpoint is staged in ``<path>.tmp-<nonce>``
+    (chunks fsync'd, manifest carrying ``committed: true`` + per-chunk
+    sha256) and committed by a single directory rename (fresh path) or a
+    data-dir move + atomic manifest replace (re-save in place), so a
+    kill at any byte offset leaves either the previous checkpoint fully
+    intact or the new one fully committed — never a torn mix.  Orphaned
+    staging dirs from kills are swept by the next successful save to the
+    same path (and by ``CheckpointManager.gc_stale``).  Multi-host
+    callers must call this collectively from the main thread: the save
+    nonce is agreed via a broadcast at entry (which doubles as an entry
+    barrier); per-host completion markers carry each host's chunk
+    hashes so the coordinator can write a complete manifest.
     """
-    os.makedirs(path, exist_ok=True)
+    _surface_failed_async_saves()
     nproc = jax.process_count()
     pidx = jax.process_index()
     if nproc > 1:
@@ -148,15 +409,23 @@ def save_state_dict(state_dict, path: str, process_group=None,
             seed, is_source=pidx == coordinator_rank)), "08x")
     else:
         nonce = format(int.from_bytes(os.urandom(4), "little"), "08x")
+
+    path = path.rstrip("/")
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    in_place = os.path.isdir(path)
+    staging = f"{path}.tmp-{nonce}"
     data_dir = f"data-{nonce}"
-    os.makedirs(os.path.join(path, data_dir), exist_ok=True)
+    os.makedirs(os.path.join(staging, data_dir), exist_ok=True)
+    _track_staging(staging)
 
     flat = _flatten(state_dict)
-    manifest: Dict[str, Any] = {"version": _VERSION, "arrays": {},
-                               "literals": {}, "data_dir": data_dir}
+    manifest: Dict[str, Any] = {"version": _VERSION, "committed": True,
+                                "arrays": {}, "literals": {},
+                                "data_dir": data_dir}
     writes: List[Tuple[str, np.ndarray]] = []
 
-    def chunk_path(key, box):
+    def chunk_rel(key, box):
         return f"{data_dir}/{_fname(key, box)}"
 
     for key, leaf in flat.items():
@@ -173,11 +442,10 @@ def save_state_dict(state_dict, path: str, process_group=None,
             leaf = np.asarray(leaf)
             box = _norm_box((slice(None),) * leaf.ndim, leaf.shape)
             if pidx == coordinator_rank:
-                writes.append((os.path.join(path, chunk_path(key, box)),
-                               np.asarray(leaf)))
+                writes.append((chunk_rel(key, box), np.asarray(leaf)))
             manifest["arrays"][key] = {
                 "global_shape": list(leaf.shape), "dtype": str(leaf.dtype),
-                "chunks": [{"file": chunk_path(key, box),
+                "chunks": [{"file": chunk_rel(key, box),
                             "box": [list(b) for b in box]}]}
             continue
 
@@ -188,7 +456,7 @@ def save_state_dict(state_dict, path: str, process_group=None,
         boxes = sorted({_norm_box(idx, shape) for idx in idx_map.values()})
         manifest["arrays"][key] = {
             "global_shape": list(shape), "dtype": str(leaf.dtype),
-            "chunks": [{"file": chunk_path(key, b),
+            "chunks": [{"file": chunk_rel(key, b),
                         "box": [list(x) for x in b]} for b in boxes]}
         # process-local (fully-addressable) arrays look identical on every
         # multi-host process — e.g. an RNG key or a host-replicated scalar.
@@ -203,71 +471,236 @@ def save_state_dict(state_dict, path: str, process_group=None,
             if shard.replica_id != 0:
                 continue
             box = _norm_box(shard.index, shape)
-            writes.append((os.path.join(path, chunk_path(key, box)),
-                           np.asarray(shard.data)))
+            writes.append((chunk_rel(key, box), np.asarray(shard.data)))
+
+    handle = AsyncSaveHandle(path) if async_save else None
+    mode = "async" if async_save else "sync"
 
     def flush():
-        for fpath, arr in writes:
-            np.save(fpath, arr, allow_pickle=False)
-        # the manifest is the commit point: written only after every chunk
-        # is flushed, via tmp+rename so readers never see a manifest that
-        # references missing/truncated chunk files.  Multi-host sync uses
-        # per-save-nonce marker files on the (shared) checkpoint dir — NOT
-        # a device collective, which on a background thread could
-        # interleave with the main thread's training collectives and
-        # deadlock.  The nonce in the marker name means markers from an
-        # interrupted earlier save can never satisfy this wait.
+        t0 = time.monotonic()
+        total_bytes = 0
+        digests: Dict[str, Dict[str, Any]] = {}
+        # chaos points count SAVES (not chunks) so `point:N` schedules
+        # uniformly mean "the Nth save" — a mid-chunk hit tears the
+        # first chunk at half its bytes and dies there
+        torn_save = _chaos_hit("mid-chunk")
+        for i, (rel, arr) in enumerate(writes):
+            data = _npy_bytes(arr)
+            if torn_save and i == 0:
+                data = data[:max(1, len(data) // 2)]
+            fpath = os.path.join(staging, rel)
+            with open(fpath, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            if torn_save and i == 0:
+                _chaos_crash("mid-chunk")
+            digests[rel] = {"sha256": hashlib.sha256(data).hexdigest(),
+                            "bytes": len(data)}
+            total_bytes += len(data)
+        if torn_save and not writes:
+            _chaos_crash("mid-chunk")
+        _fsync_dir(os.path.join(staging, data_dir))
+        if _chaos_hit("pre-manifest"):
+            _chaos_crash("pre-manifest")
+
+        # multi-host sync uses per-save-nonce marker files in the (shared)
+        # staging dir — NOT a device collective, which on a background
+        # thread could interleave with the main thread's training
+        # collectives and deadlock.  Markers carry this host's chunk
+        # digests so the coordinator's manifest covers every chunk.
         if nproc > 1:
-            with open(os.path.join(path, f".{nonce}.proc{pidx}.done"),
-                      "w"):
-                pass
-        if pidx == coordinator_rank:
-            if nproc > 1:
-                deadline = time.monotonic() + 600.0
-                want = [os.path.join(path, f".{nonce}.proc{i}.done")
-                        for i in range(nproc)]
-                while not all(os.path.exists(w) for w in want):
-                    enforce(time.monotonic() < deadline,
-                            "timed out waiting for other hosts' shards")
-                    time.sleep(0.2)
-            tmp = os.path.join(path, _METADATA + ".tmp")
-            with open(tmp, "w") as f:
-                json.dump(manifest, f, indent=1)
+            marker = os.path.join(staging, f".{nonce}.proc{pidx}.done")
+            with open(marker, "w") as f:
+                json.dump(digests, f)
+            if pidx != coordinator_rank:
+                # the coordinator owns the commit (and the rename that
+                # consumes the staging dir) — stop tracking it here
+                _untrack_staging(staging)
+                return
+            deadline = time.monotonic() + 600.0
+            want = [os.path.join(staging, f".{nonce}.proc{i}.done")
+                    for i in range(nproc)]
+            while not all(os.path.exists(w) for w in want):
+                enforce(time.monotonic() < deadline,
+                        "timed out waiting for other hosts' shards")
+                time.sleep(0.2)
+            for w in want:
+                with open(w) as f:
+                    digests.update(json.load(f))
+                os.remove(w)
+
+        # fill per-chunk integrity info, then the COMMITTED manifest —
+        # written only after every chunk is flushed.  Inside the private
+        # staging dir a plain write is safe; atomicity comes from the
+        # commit rename below.
+        for entry in manifest["arrays"].values():
+            for chunk in entry["chunks"]:
+                d = digests.get(chunk["file"])
+                if d is not None:
+                    chunk.update(d)
+        mdata = json.dumps(manifest, indent=1).encode()
+        with open(os.path.join(staging, _METADATA), "wb") as f:
+            f.write(mdata)
+            f.flush()
+            os.fsync(f.fileno())
+        total_bytes += len(mdata)
+        _fsync_dir(staging)
+        if _chaos_hit("pre-rename"):
+            _chaos_crash("pre-rename")
+
+        # commit
+        if in_place:
+            # readers see the OLD manifest (complete old checkpoint)
+            # until the manifest replace lands
+            os.rename(os.path.join(staging, data_dir),
+                      os.path.join(path, data_dir))
+            tmp = os.path.join(path, _METADATA + f".tmp-{nonce}")
+            os.rename(os.path.join(staging, _METADATA), tmp)
             os.replace(tmp, os.path.join(path, _METADATA))
-            # GC: orphaned data dirs from older/interrupted saves, and
-            # this save's markers (only AFTER the commit point)
-            import shutil
-            for entry in os.listdir(path):
-                full = os.path.join(path, entry)
-                if entry.startswith("data-") and entry != data_dir:
+            _fsync_dir(path)
+            shutil.rmtree(staging, ignore_errors=True)
+        else:
+            os.rename(staging, path)
+            _fsync_dir(parent)
+        _untrack_staging(staging)
+        if _chaos_hit("post-commit"):
+            _chaos_crash("post-commit")
+
+        # GC (only AFTER the commit point): data dirs from older /
+        # interrupted in-place saves, stale marker files, and orphaned
+        # sibling staging dirs from earlier killed saves to this path
+        for entry in os.listdir(path):
+            full = os.path.join(path, entry)
+            if entry.startswith("data-") and entry != data_dir:
+                shutil.rmtree(full, ignore_errors=True)
+            elif (entry.startswith(".") and entry.endswith(".done")) or \
+                    entry.startswith(_METADATA + ".tmp-"):
+                # stale markers, and a manifest tmp left by a crash
+                # between the two commit renames of an in-place re-save
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+        base = os.path.basename(path)
+        for entry in os.listdir(parent):
+            if entry.startswith(base + ".tmp-"):
+                full = os.path.join(parent, entry)
+                if full != staging and os.path.isdir(full):
                     shutil.rmtree(full, ignore_errors=True)
-                elif entry.startswith(".") and entry.endswith(".done"):
-                    try:
-                        os.remove(full)
-                    except OSError:
-                        pass
+                    _untrack_staging(full)
+
+        hist, bytes_ctr = _save_metrics()
+        hist.labels(mode).observe(time.monotonic() - t0)
+        bytes_ctr.inc(total_bytes)
+        if handle is not None:
+            handle.bytes_written = total_bytes
 
     if async_save:
-        t = threading.Thread(target=flush, daemon=False)
+        def run():
+            try:
+                flush()
+            except BaseException as e:   # surfaced via handle/next save
+                handle._exc = e
+
+        t = threading.Thread(target=run, daemon=False,
+                             name="paddle-tpu-ckpt-writer")
+        handle._thread = t
+        _remember_handle(handle)
         t.start()
-        return t
+        return handle
     flush()
     return None
 
 
 # ---------------------------------------------------------------------------
-# load
+# load + validation
 # ---------------------------------------------------------------------------
 
 def get_checkpoint_metadata(path: str) -> Dict[str, Any]:
-    with open(os.path.join(path, _METADATA)) as f:
-        return json.load(f)
+    """Parse and sanity-check the manifest.  Raises
+    :class:`CorruptCheckpointError` when it is missing, torn, from an
+    unknown version, or was never committed."""
+    mpath = os.path.join(path, _METADATA)
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise CorruptCheckpointError(
+            f"{path}: no {_METADATA} — not a committed checkpoint "
+            f"(torn write from a crashed save, or wrong directory)")
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CorruptCheckpointError(f"{path}: torn {_METADATA}: {e}")
+    if meta.get("version") not in _KNOWN_VERSIONS:
+        raise CorruptCheckpointError(
+            f"{path}: unknown checkpoint version {meta.get('version')}")
+    if meta.get("version", 0) >= 2 and not meta.get("committed"):
+        raise CorruptCheckpointError(
+            f"{path}: manifest present but not committed")
+    return meta
+
+
+def _hash_file(fpath: str) -> str:
+    h = hashlib.sha256()
+    with open(fpath, "rb") as f:
+        for blk in iter(lambda: f.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest()
+
+
+def _verify_chunk(root: str, chunk: Dict[str, Any], cache: Set[str]):
+    """sha256-verify one chunk file against the manifest (once per load:
+    the cache spans the whole load_state_dict call)."""
+    rel = chunk["file"]
+    if rel in cache:
+        return
+    fpath = os.path.join(root, rel)
+    if not os.path.exists(fpath):
+        raise CorruptCheckpointError(
+            f"{root}: missing chunk file {rel!r}")
+    want_bytes = chunk.get("bytes")
+    if want_bytes is not None and os.path.getsize(fpath) != want_bytes:
+        raise CorruptCheckpointError(
+            f"{root}: chunk {rel!r} is {os.path.getsize(fpath)} bytes, "
+            f"manifest says {want_bytes} (truncated write?)")
+    want = chunk.get("sha256")
+    if want is not None and _hash_file(fpath) != want:
+        raise CorruptCheckpointError(
+            f"{root}: chunk {rel!r} sha256 mismatch (bit-rot or torn "
+            f"write)")
+    cache.add(rel)
+
+
+def validate_checkpoint(path: str, deep: bool = True) -> Dict[str, Any]:
+    """Integrity-check a checkpoint dir WITHOUT materializing arrays:
+    committed manifest, every chunk file present with the manifest's
+    size, and (``deep=True``) matching sha256.  Returns the metadata;
+    raises :class:`CorruptCheckpointError` on any failure."""
+    meta = get_checkpoint_metadata(path)
+    cache: Set[str] = set()
+    for entry in meta["arrays"].values():
+        for chunk in entry["chunks"]:
+            if deep:
+                _verify_chunk(path, chunk, cache)
+            else:
+                fpath = os.path.join(path, chunk["file"])
+                if not os.path.exists(fpath):
+                    raise CorruptCheckpointError(
+                        f"{path}: missing chunk file {chunk['file']!r}")
+                want_bytes = chunk.get("bytes")
+                if want_bytes is not None and \
+                        os.path.getsize(fpath) != want_bytes:
+                    raise CorruptCheckpointError(
+                        f"{path}: chunk {chunk['file']!r} size mismatch")
+    return meta
 
 
 def _read_box(path: str, entry: Dict[str, Any], want: Tuple[slice, ...],
-              shape: Sequence[int], dtype) -> np.ndarray:
+              shape: Sequence[int], dtype,
+              verify_cache: Optional[Set[str]] = None) -> np.ndarray:
     """Assemble the requested index box from the chunk files that overlap
-    it.  Chunks are mmap'd so only the overlapping bytes are read."""
+    it.  Chunks are mmap'd so only the overlapping bytes are read; with a
+    ``verify_cache``, each touched chunk file is sha256-verified first."""
     want_box = _norm_box(want, shape)
     out = np.empty([b - a for a, b in want_box], dtype=dtype)
     filled = 0
@@ -277,6 +710,8 @@ def _read_box(path: str, entry: Dict[str, Any], want: Tuple[slice, ...],
                  for (a0, a1), (b0, b1) in zip(want_box, cbox)]
         if any(a >= b for a, b in inter):
             continue
+        if verify_cache is not None:
+            _verify_chunk(path, chunk, verify_cache)
         src = np.load(os.path.join(path, chunk["file"]), mmap_mode="r",
                       allow_pickle=False)
         if src.dtype != dtype:
@@ -291,7 +726,8 @@ def _read_box(path: str, entry: Dict[str, Any], want: Tuple[slice, ...],
         filled += int(np.prod([b - a for a, b in inter]))
     enforce(filled == out.size,
             f"checkpoint chunks do not cover requested box {want_box} "
-            f"(covered {filled}/{out.size} elements)")
+            f"(covered {filled}/{out.size} elements)",
+            error_cls=CorruptCheckpointError)
     return out
 
 
@@ -304,7 +740,8 @@ def _target_sharding(leaf) -> Optional[jax.sharding.Sharding]:
 
 
 def load_state_dict(state_dict, path: str, process_group=None,
-                    coordinator_rank: int = 0, metadata=None):
+                    coordinator_rank: int = 0, metadata=None,
+                    verify: bool = True):
     """Fill ``state_dict`` (a template pytree — e.g. a freshly-initialized
     model/optimizer state, possibly sharded over a *different* mesh than
     the checkpoint was saved from) from the checkpoint at ``path``.
@@ -312,10 +749,18 @@ def load_state_dict(state_dict, path: str, process_group=None,
     Tensor leaves are updated in place; the (re-built) tree is also
     returned for functional callers (raw jax pytrees).  Each array is
     materialized directly into the template leaf's sharding.
+
+    With ``verify=True`` (default) every chunk file read is
+    sha256-checked against the manifest first and any corruption raises
+    :class:`CorruptCheckpointError` BEFORE the template is mutated —
+    a partially-restored state is never left behind.
     """
+    t0 = time.monotonic()
     meta = metadata if metadata is not None else get_checkpoint_metadata(path)
-    enforce(meta.get("version") == _VERSION,
-            f"unknown checkpoint version {meta.get('version')}")
+    enforce(meta.get("version") in _KNOWN_VERSIONS,
+            f"unknown checkpoint version {meta.get('version')}",
+            error_cls=CorruptCheckpointError)
+    verify_cache: Optional[Set[str]] = set() if verify else None
     flat = _flatten(state_dict)
     new_flat: Dict[str, Any] = {}
     for key, leaf in flat.items():
@@ -336,7 +781,7 @@ def load_state_dict(state_dict, path: str, process_group=None,
         if sharding is None:
             arr = jax.numpy.asarray(
                 _read_box(path, entry, (slice(None),) * len(shape), shape,
-                          dtype).astype(out_dtype))
+                          dtype, verify_cache).astype(out_dtype))
         else:
             enforce(tuple(tmpl_arr.shape) == shape,
                     f"{key!r}: template shape {tuple(tmpl_arr.shape)} != "
@@ -344,9 +789,11 @@ def load_state_dict(state_dict, path: str, process_group=None,
             arr = jax.make_array_from_callback(
                 shape, sharding,
                 lambda idx, e=entry: _read_box(path, e, idx, shape,
-                                               dtype).astype(out_dtype))
+                                               dtype, verify_cache
+                                               ).astype(out_dtype))
         new_flat[key] = arr
 
     for key, val in new_flat.items():
         _set_in(state_dict, key, val)
+    _load_metrics().observe(time.monotonic() - t0)
     return state_dict

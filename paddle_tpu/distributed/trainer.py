@@ -74,6 +74,10 @@ class ShardedTrainStep(CompiledTrainStep):
         self.state, loss = self._step_fn(self.state, batch, sub, lr)
         if self._timer is not None:
             self._timer.stop(fence=(self.state, loss))
+        # same resumable-state contract as the parent: the update count
+        # must tick here too or a sharded run's checkpoint lies about
+        # its position
+        self._step_count += 1
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()

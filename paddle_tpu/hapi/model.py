@@ -212,7 +212,9 @@ class Model:
     # -- loops ---------------------------------------------------------------
     def _loader(self, data, batch_size, shuffle, num_workers,
                 drop_last=False):
-        if data is None or isinstance(data, DataLoader):
+        from ..io.dataloader import CheckpointableLoader
+        if data is None or isinstance(data, (DataLoader,
+                                             CheckpointableLoader)):
             return data
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
@@ -229,11 +231,59 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
+            callbacks=None, checkpoint_dir=None, save_steps=None,
+            auto_resume=True):
+        """Train.  Crash-safe checkpointing: with ``checkpoint_dir`` set
+        (a path, or a configured ``distributed.CheckpointManager`` for
+        async saves / custom retention), the FULL training state —
+        params, optimizer state, RNG stream, LR-scheduler and loader
+        position — is checkpointed every ``save_steps`` optimizer steps
+        (atomic commit: a kill mid-save never leaves a torn checkpoint)
+        and at train end; ``auto_resume=True`` restores the latest valid
+        checkpoint before the first step and continues exactly where the
+        interrupted run stopped.  Pass the data as a
+        ``CheckpointableLoader`` for mid-epoch exactness (the loader
+        position rides in the checkpoint and the resumed loss trajectory
+        is bit-identical to an uninterrupted run); with a plain loader,
+        resume restarts the interrupted epoch from its first batch.  If
+        ``manager.install_preemption_hook()`` was armed, a SIGTERM saves
+        and stops cleanly after the in-flight step."""
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
         enforce(loader is not None, "fit needs train_data")
         step = self._ensure_train_step()
+
+        manager = None
+        start_epoch = 0
+        global_step = 0
+        last_saved = -1
+        loader_ckptable = hasattr(loader, "state_dict") and \
+            hasattr(loader, "set_state_dict")
+        if checkpoint_dir is not None:
+            from ..distributed.ckpt_manager import CheckpointManager
+            manager = checkpoint_dir if isinstance(
+                checkpoint_dir, CheckpointManager) else \
+                CheckpointManager(str(checkpoint_dir))
+            if auto_resume:
+                restored = manager.restore(step)
+                if restored is not None:
+                    rstep, extra = restored
+                    extra = extra or {}
+                    global_step = int(extra.get("global_step", rstep))
+                    start_epoch = int(extra.get("epoch", 0))
+                    lstate = extra.get("loader")
+                    if lstate is not None and loader_ckptable:
+                        loader.set_state_dict(lstate)
+
+        def ckpt_extra(epoch):
+            lstate = loader.state_dict() if loader_ckptable else None
+            # the epoch to RESUME INTO: the loader's cursor epoch when
+            # it is checkpointable (it already advanced past an epoch
+            # boundary), else the current one (replayed from batch 0)
+            return {"global_step": global_step,
+                    "epoch": int(lstate["epoch"]) if lstate is not None
+                    else epoch,
+                    "loader": lstate}
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, batch_size=batch_size,
@@ -256,7 +306,9 @@ class Model:
         cbks.on_train_begin()
         logs = {}
         self._in_fit = True
-        for epoch in range(epochs):
+        cur_epoch = start_epoch
+        for epoch in range(start_epoch, epochs):
+            cur_epoch = epoch
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
@@ -299,11 +351,35 @@ class Model:
                     mlogs.pop("loss", None)
                     logs.update(mlogs)
                 cbks.on_train_batch_end(step_i, logs)
+                global_step += 1
+                if manager is not None:
+                    preempted = getattr(manager, "preempted", False)
+                    if preempted or (save_steps and
+                                     global_step % save_steps == 0):
+                        manager.save(step, global_step,
+                                     extra_state=ckpt_extra(epoch))
+                        last_saved = global_step
+                    if preempted:
+                        # SIGTERM landed: state is on disk, exit the
+                        # loop cleanly before the scheduler's SIGKILL
+                        self.stop_training = True
+                if self.stop_training:
+                    break
             cbks.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
                               verbose=verbose, num_workers=num_workers,
                               callbacks=cbks)
+        if manager is not None:
+            # final checkpoint (unless the last save already covers this
+            # step), then drain the async queue so a background-writer
+            # failure surfaces HERE, not at interpreter exit
+            if global_step > 0 and last_saved != global_step:
+                # a stopped run resumes INTO the interrupted epoch; a
+                # completed one records `epochs` so resume is a no-op
+                manager.save(step, global_step, extra_state=ckpt_extra(
+                    cur_epoch if self.stop_training else epochs))
+            manager.wait()
         cbks.on_train_end(logs)
         # the VisualDL callback closed its writer above — detach the
         # timer so later direct train_batch calls can't write into it

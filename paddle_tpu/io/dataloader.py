@@ -25,7 +25,7 @@ __all__ = [
     "ChainDataset", "ConcatDataset", "Subset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
-    "DataLoader", "get_worker_info",
+    "DataLoader", "CheckpointableLoader", "get_worker_info",
 ]
 
 
@@ -530,3 +530,88 @@ class DataLoader:
         if self._iterable:
             raise RuntimeError("IterableDataset loader has no len()")
         return len(self.batch_sampler)
+
+
+class CheckpointableLoader:
+    """Deterministic, position-checkpointable batch loader — the data
+    half of exact training resume (SURVEY.md §5 checkpoint/resume).
+
+    Wraps a map-style dataset with its OWN seeded per-epoch shuffle
+    (derived from ``(seed, epoch)`` via a private Generator — the global
+    ``np.random`` stream is untouched), so the batch order of any epoch
+    is reproducible in a fresh process.  The loader tracks its cursor as
+    it yields: between two batches, ``state_dict()`` fully describes the
+    stream position and ``set_state_dict`` fast-forwards to it WITHOUT
+    materializing skipped items (skipped indices never hit
+    ``dataset[i]``).  hapi ``fit(checkpoint_dir=..., auto_resume=True)``
+    saves/restores this state alongside the model, so a resumed run
+    consumes exactly the batches the interrupted run did not — the
+    prerequisite for a bit-identical loss trajectory.
+
+    Iterating resumes the CURRENT epoch at the cursor (mid-epoch after
+    ``set_state_dict``, else batch 0) and auto-advances the epoch at
+    exhaustion, so ``for epoch in ...: for batch in loader:`` walks
+    distinct shuffles with no ``set_epoch`` bookkeeping.
+    """
+
+    def __init__(self, dataset, batch_size: int = 1, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = False, collate_fn=None):
+        enforce(not isinstance(dataset, IterableDataset),
+                "CheckpointableLoader needs a map-style dataset (an "
+                "iterable stream has no random-accessible position to "
+                "checkpoint)")
+        enforce(batch_size >= 1, "batch_size must be >= 1")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self._epoch = 0
+        self._next_batch = 0
+
+    def _order(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if not self.shuffle:
+            return np.arange(n)
+        rng = np.random.Generator(np.random.PCG64(
+            np.random.SeedSequence([self.seed, int(epoch)])))
+        return rng.permutation(n)
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        order = self._order(self._epoch)
+        n_batches = len(self)
+        for bi in range(self._next_batch, n_batches):
+            idxs = order[bi * self.batch_size:(bi + 1) * self.batch_size]
+            items = [self.dataset[int(i)] for i in idxs]
+            # cursor advances BEFORE the yield: a state_dict() taken
+            # after consuming this batch points at the next one
+            self._next_batch = bi + 1
+            yield self.collate_fn(items)
+        self._epoch += 1
+        self._next_batch = 0
+
+    # -- position checkpointing ----------------------------------------------
+    def state_dict(self):
+        return {"epoch": self._epoch, "next_batch": self._next_batch,
+                "seed": self.seed, "shuffle": self.shuffle,
+                "batch_size": self.batch_size}
+
+    def set_state_dict(self, state):
+        # a position is only meaningful under the SAME ordering config —
+        # resuming a seed-5 run with a seed-9 loader would silently
+        # replay/skip the wrong samples
+        for k in ("seed", "shuffle", "batch_size"):
+            if k in state:
+                enforce(state[k] == getattr(self, k),
+                        f"loader {k} mismatch on resume: checkpoint has "
+                        f"{state[k]!r}, this loader has "
+                        f"{getattr(self, k)!r}")
+        self._epoch = int(state["epoch"])
+        self._next_batch = int(state["next_batch"])

@@ -86,6 +86,9 @@ class CompiledTrainStep:
         self._has_aux = has_aux
         self._timer = None
         self._flops_cache = None
+        # optimizer-update count (fused __call__ + apply_grads); part of
+        # the resumable state so a restored run knows where it is
+        self._step_count = 0
 
     # -- telemetry -----------------------------------------------------------
     def attach_timer(self, timer):
@@ -163,6 +166,7 @@ class CompiledTrainStep:
                                         lr)
         if self._timer is not None:
             self._timer.stop(fence=(self.state, out))
+        self._step_count += 1
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()
@@ -220,21 +224,31 @@ class CompiledTrainStep:
                 apply, donate_argnums=(0,) if self._donate else ())
         self.state = self._apply_fn(self.state, grads,
                                     self.optimizer.get_lr())
+        self._step_count += 1
         sched = self.optimizer._lr_scheduler
         if sched is not None:
             sched.step()
 
     # -- checkpoint/resume ---------------------------------------------------
     def _ckpt_tree(self):
-        """The resumable state: params+opt, RNG stream, LR-sched position.
-        One definition shared by save and load so the trees can't drift."""
+        """The resumable ARRAY state: params+opt (which carries the
+        optimizer's own step counter), plus the RNG stream.  One
+        definition shared by save and load so the trees can't drift.
+        Literal state (LR-sched position, step count, trainer-loop
+        extras) rides in the manifest's literals — see save_checkpoint."""
         return {"state": self.state,
                 "rng_key": jax.random.key_data(self._key)}
 
-    def save_checkpoint(self, path: str, async_save: bool = False):
+    def save_checkpoint(self, path: str, async_save: bool = False,
+                        extra_state=None):
         """Sharded checkpoint of the full training state (params, optimizer
-        state, RNG stream, LR-scheduler position) — resumable on any mesh
-        via distributed.checkpoint's reshard-on-load."""
+        state incl. its step counter, RNG stream, LR-scheduler position,
+        update count) — resumable on any mesh via
+        distributed.checkpoint's reshard-on-load.  ``extra_state`` (a
+        JSON-able dict — epoch/loader position from the training loop)
+        rides along and comes back from ``load_checkpoint``.  With
+        ``async_save=True`` returns an AsyncSaveHandle whose ``wait()``
+        surfaces writer failures."""
         import json
         from ..distributed import checkpoint as dck
         sched = self.optimizer._lr_scheduler
@@ -243,6 +257,9 @@ class CompiledTrainStep:
         # boundaries) which must not be key-flattened into the manifest
         tree["lr_sched"] = json.dumps(sched.state_dict()) \
             if sched is not None else None
+        tree["step_count"] = int(self._step_count)
+        if extra_state is not None:
+            tree["extra"] = json.dumps(extra_state)
         return dck.save_state_dict(tree, path, async_save=async_save)
 
     def load_checkpoint(self, path: str):
@@ -251,7 +268,10 @@ class CompiledTrainStep:
         checkpoint was written from) is the template.  Scheduler state is
         restored only when both sides have a scheduler, so resuming a
         scheduled run with a constant LR (or vice versa) still restores
-        params/opt/RNG."""
+        params/opt/RNG.  Every chunk read is sha256-verified; corruption
+        raises CorruptCheckpointError BEFORE any state is mutated.
+        Returns the ``extra_state`` dict saved alongside (None if none
+        was)."""
         import json
         from ..distributed import checkpoint as dck
         meta = dck.get_checkpoint_metadata(path)
@@ -259,10 +279,13 @@ class CompiledTrainStep:
         dck.load_state_dict(tree, path, metadata=meta)
         self.state = tree["state"]
         self._key = jax.random.wrap_key_data(tree["rng_key"])
+        self._step_count = int(meta["literals"].get("step_count") or 0)
         sched = self.optimizer._lr_scheduler
         saved = meta["literals"].get("lr_sched")
         if sched is not None and saved:
             sched.set_state_dict(json.loads(saved))
+        extra = meta["literals"].get("extra")
+        return json.loads(extra) if extra else None
 
     # -- state sync with the eager model ------------------------------------
     def sync_to_model(self):

@@ -16,6 +16,12 @@ Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
 gauges, compile-count gauges) lives with the instrumented code in
 ``inference/engine.py`` / ``inference/paged_cache.py`` and surfaces
 through ``LLMEngine.metrics_snapshot()`` plus the registry exposition.
+Checkpoint instrumentation likewise lives at its seams
+(``distributed/checkpoint.py`` / ``distributed/ckpt_manager.py``):
+``ckpt_save_seconds{mode=sync|async}`` / ``ckpt_load_seconds``
+histograms, ``ckpt_bytes_written_total`` and ``ckpt_corruption_total``
+counters, and the ``ckpt_async_queue_depth`` gauge over the bounded
+write-behind save queue.
 """
 from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
                       DEFAULT_BUCKETS, get_registry)

@@ -1,0 +1,70 @@
+"""Standalone trainer for the kill-based checkpoint chaos soak.
+
+Run as ``python ckpt_chaos_worker.py <mode> <workdir> <total> <save_steps>``:
+
+- ``ref``: train ``total`` steps with NO checkpointing, appending
+  ``{"step": i, "loss": l}`` lines to ``<workdir>/losses_ref.jsonl``.
+- ``run``: same model/batches with a CheckpointManager under
+  ``<workdir>/ck`` saving every ``save_steps`` steps, auto-resuming from
+  the latest valid checkpoint at startup, appending to
+  ``losses_run.jsonl``.
+
+The parent test arms ``PADDLE_TPU_CKPT_CHAOS=<point>:<nth>:exit`` so the
+Nth save dies with ``os._exit(17)`` at the scheduled point (mid-chunk
+torn write / pre-manifest / pre-rename), then re-runs ``run`` without
+chaos: auto_resume must land on a valid checkpoint and the per-step loss
+trajectory (last occurrence per step across the killed + resumed runs)
+must be bit-identical to ``ref``.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    mode, workdir, total, save_steps = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+    from paddle_tpu.jit.train import CompiledTrainStep
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.AdamW(learning_rate=1e-2)
+
+    def loss_fn(m, b):
+        d = m(b["x"]) - b["y"]
+        return (d * d).mean()
+
+    step = CompiledTrainStep(net, loss_fn, opt, seed=0)
+    rng = np.random.default_rng(5)
+    batches = [{"x": rng.normal(size=(4, 8)).astype(np.float32),
+                "y": rng.normal(size=(4, 4)).astype(np.float32)}
+               for _ in range(total)]
+
+    start = 0
+    manager = None
+    if mode == "run":
+        manager = CheckpointManager(os.path.join(workdir, "ck"),
+                                    keep_last_n=3)
+        got = manager.restore(step)
+        if got is not None:
+            start = got[0]
+
+    losses_path = os.path.join(workdir, f"losses_{mode}.jsonl")
+    with open(losses_path, "a") as f:
+        for i in range(start, total):
+            loss = float(step(batches[i]))
+            f.write(json.dumps({"step": i + 1, "loss": loss}) + "\n")
+            f.flush()
+            if manager is not None and (i + 1) % save_steps == 0:
+                manager.save(step, i + 1)   # chaos may _exit(17) here
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,12 @@
 """tests/ conftest: fleet/mesh state is torn down after every test so
-topology-building tests can't leak meshes into each other, and a
+topology-building tests can't leak meshes into each other; a
 thread-leak guard keeps the serving tier's HTTP servers / probers /
-loop threads from outliving their test (a leaked loop thread is how a
-tier-1 run hangs on a 1-core box)."""
+loop threads — and the checkpoint tier's ``paddle-tpu-ckpt-writer``
+async-save threads — from outliving their test (a leaked loop thread is
+how a tier-1 run hangs on a 1-core box); and a staging-dir guard fails
+any test that leaves ``*.tmp-<nonce>`` checkpoint staging dirs behind
+(an un-swept torn save — call ``CheckpointManager.gc_stale()`` or do a
+recovery save before returning)."""
 import threading
 import time
 
@@ -47,3 +51,21 @@ def _no_thread_leaks():
         f"{[(t.name, 'daemon' if t.daemon else 'non-daemon') for t in left]} "
         f"— shut down frontends/probers (fe.shutdown(), prober.stop()) "
         f"before returning")
+
+
+@pytest.fixture(autouse=True)
+def _no_ckpt_staging_leaks():
+    """Fail any test that leaves a live ``*.tmp-<nonce>`` checkpoint
+    staging dir on disk: an uncommitted save the test neither swept
+    (``CheckpointManager.gc_stale()``) nor recovered with a follow-up
+    save.  The registry is cleared either way so one leak can't cascade
+    into every later test."""
+    yield
+    from paddle_tpu.distributed import checkpoint as _ckpt
+    left = _ckpt.staging_dirs_alive()
+    for p in left:
+        _ckpt._untrack_staging(p)
+    assert not left, (
+        f"checkpoint staging dirs leaked past the test: {left} — a "
+        f"crashed/failed save was never swept (gc_stale) or recovered "
+        f"(follow-up save)")

@@ -244,3 +244,299 @@ class TestTrainResume:
         t.join(timeout=60)
         step2 = _make_sharded_step(stage=1)
         step2.load_checkpoint(str(tmp_path / "ck"))
+
+
+# ---------------------------------------------------------------------------
+# PR 7: atomic commit, corruption detection, async handles, exact resume
+# ---------------------------------------------------------------------------
+import os
+import time
+
+from paddle_tpu.common.errors import CorruptCheckpointError
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import (ChaosCrash, clear_chaos,
+                                               get_checkpoint_metadata,
+                                               set_chaos,
+                                               validate_checkpoint)
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    clear_chaos()
+
+
+def _chunk_files(path):
+    meta = get_checkpoint_metadata(str(path))
+    return [os.path.join(str(path), c["file"])
+            for e in meta["arrays"].values() for c in e["chunks"]]
+
+
+class TestAtomicCommit:
+    def test_sha256_and_committed_in_manifest(self, tmp_path):
+        save_state_dict({"x": np.arange(8, dtype=np.float32)},
+                        str(tmp_path / "ck"))
+        meta = get_checkpoint_metadata(str(tmp_path / "ck"))
+        assert meta["committed"] is True and meta["version"] == 2
+        for entry in meta["arrays"].values():
+            for chunk in entry["chunks"]:
+                assert len(chunk["sha256"]) == 64
+                assert chunk["bytes"] > 0
+        validate_checkpoint(str(tmp_path / "ck"))
+
+    def test_kill_pre_rename_fresh_save_never_visible(self, tmp_path):
+        """A crash after the staging manifest but before the commit
+        rename leaves NO checkpoint dir — never a torn one — and the
+        orphaned staging dir is swept by the next successful save."""
+        set_chaos("pre-rename")
+        with pytest.raises(ChaosCrash):
+            save_state_dict({"x": np.ones(4, np.float32)},
+                            str(tmp_path / "ck"))
+        assert not (tmp_path / "ck").exists()
+        orphans = [d for d in os.listdir(tmp_path) if ".tmp-" in d]
+        assert len(orphans) == 1
+        assert ckpt.staging_dirs_alive()     # tracked for the leak guard
+        save_state_dict({"x": np.ones(4, np.float32) * 2},
+                        str(tmp_path / "ck"))
+        assert not [d for d in os.listdir(tmp_path) if ".tmp-" in d]
+        out = load_state_dict({"x": np.zeros(4, np.float32)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.ones(4) * 2)
+
+    def test_kill_mid_chunk_resave_keeps_old_checkpoint(self, tmp_path):
+        """A torn chunk write during a RE-save lands in staging only:
+        the committed checkpoint still validates and loads the old
+        values."""
+        a = np.arange(6, dtype=np.float32)
+        save_state_dict({"x": a}, str(tmp_path / "ck"))
+        set_chaos("mid-chunk")
+        with pytest.raises(ChaosCrash):
+            save_state_dict({"x": a * 10}, str(tmp_path / "ck"))
+        validate_checkpoint(str(tmp_path / "ck"))
+        out = load_state_dict({"x": np.zeros(6, np.float32)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), a)
+        # recovery save sweeps the torn staging dir and commits
+        save_state_dict({"x": a * 10}, str(tmp_path / "ck"))
+        assert not [d for d in os.listdir(tmp_path) if ".tmp-" in d]
+        out = load_state_dict({"x": np.zeros(6, np.float32)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), a * 10)
+
+    def test_kill_pre_manifest_fresh_save_never_visible(self, tmp_path):
+        set_chaos("pre-manifest")
+        with pytest.raises(ChaosCrash):
+            save_state_dict({"x": np.ones(3)}, str(tmp_path / "ck"))
+        assert not (tmp_path / "ck").exists()
+        with pytest.raises(CorruptCheckpointError):
+            get_checkpoint_metadata(str(tmp_path / "ck"))
+        save_state_dict({"x": np.ones(3)}, str(tmp_path / "ck"))
+        validate_checkpoint(str(tmp_path / "ck"))
+
+    def test_kill_post_commit_checkpoint_already_valid(self, tmp_path):
+        """A crash after the commit rename (before GC) leaves a fully
+        valid NEW checkpoint; leftover old data dirs are garbage, not
+        corruption, and the next save collects them."""
+        a = np.arange(4, dtype=np.float32)
+        save_state_dict({"x": a}, str(tmp_path / "ck"))
+        set_chaos("post-commit")
+        with pytest.raises(ChaosCrash):
+            save_state_dict({"x": a * 3}, str(tmp_path / "ck"))
+        validate_checkpoint(str(tmp_path / "ck"))
+        out = load_state_dict({"x": np.zeros(4, np.float32)},
+                              str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["x"]), a * 3)
+        # pre-GC crash left the previous save's data dir behind
+        datadirs = [d for d in os.listdir(tmp_path / "ck")
+                    if d.startswith("data-")]
+        assert len(datadirs) == 2
+        save_state_dict({"x": a * 4}, str(tmp_path / "ck"))
+        datadirs = [d for d in os.listdir(tmp_path / "ck")
+                    if d.startswith("data-")]
+        assert len(datadirs) == 1
+
+
+class TestCorruptionDetection:
+    def test_truncated_chunk_typed_error(self, tmp_path):
+        save_state_dict({"x": np.arange(64, dtype=np.float32)},
+                        str(tmp_path / "ck"))
+        f = _chunk_files(tmp_path / "ck")[0]
+        with open(f, "r+b") as fh:
+            fh.truncate(os.path.getsize(f) // 2)
+        with pytest.raises(CorruptCheckpointError):
+            validate_checkpoint(str(tmp_path / "ck"))
+        with pytest.raises(CorruptCheckpointError):
+            load_state_dict({"x": np.zeros(64, np.float32)},
+                            str(tmp_path / "ck"))
+
+    def test_bitflipped_chunk_typed_error(self, tmp_path):
+        save_state_dict({"x": np.arange(64, dtype=np.float32)},
+                        str(tmp_path / "ck"))
+        f = _chunk_files(tmp_path / "ck")[0]
+        with open(f, "r+b") as fh:
+            fh.seek(os.path.getsize(f) - 7)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0x40]))
+        # same size — only the sha256 catches it
+        with pytest.raises(CorruptCheckpointError):
+            validate_checkpoint(str(tmp_path / "ck"))
+        with pytest.raises(CorruptCheckpointError):
+            load_state_dict({"x": np.zeros(64, np.float32)},
+                            str(tmp_path / "ck"))
+        # shallow validation (size-only) misses a bit flip by design
+        validate_checkpoint(str(tmp_path / "ck"), deep=False)
+
+    def test_missing_chunk_and_missing_metadata(self, tmp_path):
+        save_state_dict({"x": np.arange(8, dtype=np.float32)},
+                        str(tmp_path / "ck"))
+        os.remove(_chunk_files(tmp_path / "ck")[0])
+        with pytest.raises(CorruptCheckpointError):
+            validate_checkpoint(str(tmp_path / "ck"))
+        os.remove(tmp_path / "ck" / "metadata.json")
+        with pytest.raises(CorruptCheckpointError):
+            get_checkpoint_metadata(str(tmp_path / "ck"))
+
+    def test_torn_metadata_typed_error(self, tmp_path):
+        save_state_dict({"x": np.arange(8, dtype=np.float32)},
+                        str(tmp_path / "ck"))
+        mpath = tmp_path / "ck" / "metadata.json"
+        data = mpath.read_bytes()
+        mpath.write_bytes(data[:len(data) // 2])
+        with pytest.raises(CorruptCheckpointError):
+            get_checkpoint_metadata(str(tmp_path / "ck"))
+
+    def test_template_untouched_on_corrupt_load(self, tmp_path):
+        """Verification failures raise BEFORE any template mutation —
+        a half-restored train state is worse than a failed load."""
+        save_state_dict({"a": np.arange(16, dtype=np.float32),
+                         "b": np.ones(16, np.float32)},
+                        str(tmp_path / "ck"))
+        files = sorted(_chunk_files(tmp_path / "ck"))
+        with open(files[-1], "r+b") as fh:
+            fh.seek(-3, os.SEEK_END)
+            fh.write(b"\xff")
+        tmpl = {"a": np.zeros(16, np.float32), "b": np.zeros(16, np.float32)}
+        with pytest.raises(CorruptCheckpointError):
+            load_state_dict(tmpl, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(tmpl["a"], np.zeros(16))
+        np.testing.assert_array_equal(tmpl["b"], np.zeros(16))
+
+
+class TestReshardRoundTrip:
+    """Save on an N-way CPU mesh, load on a different one (and back) —
+    the elastic-resume path the GSPMD reshard-on-load design promises."""
+
+    def test_save_2dev_load_1dev(self, tmp_path):
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("x",))
+        a = np.arange(32, dtype=np.float32).reshape(8, 4)
+        xs = jax.device_put(a, NamedSharding(mesh, PartitionSpec("x")))
+        save_state_dict({"w": xs}, str(tmp_path / "ck"))
+        meta = get_checkpoint_metadata(str(tmp_path / "ck"))
+        assert len(meta["arrays"]["w"]["chunks"]) == 2
+        tmpl = jax.device_put(np.zeros((8, 4), np.float32),
+                              jax.devices()[0])
+        out = load_state_dict({"w": tmpl}, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["w"]), a)
+
+    def test_save_1dev_load_2dev(self, tmp_path):
+        a = np.arange(32, dtype=np.float32).reshape(8, 4)
+        xs = jax.device_put(a, jax.devices()[0])
+        save_state_dict({"w": xs}, str(tmp_path / "ck"))
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("x",))
+        tmpl = jax.device_put(np.zeros((8, 4), np.float32),
+                              NamedSharding(mesh, PartitionSpec("x")))
+        out = load_state_dict({"w": tmpl}, str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(out["w"]), a)
+        assert out["w"].sharding.spec == PartitionSpec("x")
+
+
+class TestAsyncSaveHandle:
+    def test_success_wait_and_bytes(self, tmp_path):
+        h = save_state_dict({"x": np.arange(256, dtype=np.float32)},
+                            str(tmp_path / "ck"), async_save=True)
+        assert h.wait(timeout=60) is True
+        assert h.done() and h.exception() is None
+        assert h.bytes_written > 256 * 4
+        validate_checkpoint(str(tmp_path / "ck"))
+
+    def test_wait_surfaces_writer_failure(self, tmp_path):
+        set_chaos("pre-rename")
+        h = save_state_dict({"x": np.ones(4, np.float32)},
+                            str(tmp_path / "ck"), async_save=True)
+        with pytest.raises(ChaosCrash):
+            h.wait(timeout=60)
+        assert not (tmp_path / "ck").exists()
+        # recovery sweeps the orphan
+        save_state_dict({"x": np.ones(4, np.float32)}, str(tmp_path / "ck"))
+
+    def test_unwaited_failure_surfaces_at_next_save(self, tmp_path):
+        set_chaos("pre-rename")
+        h = save_state_dict({"x": np.ones(4, np.float32)},
+                            str(tmp_path / "ck"), async_save=True)
+        deadline = time.monotonic() + 60
+        while not h.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.done()
+        # nobody called wait(): the failure must NOT vanish — the next
+        # save raises it
+        with pytest.raises(RuntimeError) as ei:
+            save_state_dict({"y": np.ones(2, np.float32)},
+                            str(tmp_path / "ck2"))
+        assert isinstance(ei.value.__cause__, ChaosCrash)
+        # surfaced once: saves work again afterwards (and sweep staging)
+        save_state_dict({"x": np.ones(4, np.float32)}, str(tmp_path / "ck"))
+        validate_checkpoint(str(tmp_path / "ck"))
+
+
+class TestBitIdenticalResumeSingleChip:
+    """Satellite: everything resume needs (params, opt slots + step,
+    RNG stream through dropout, LR-scheduler position, update count)
+    round-trips through save/load on the plain single-chip
+    CompiledTrainStep — the resumed loss trajectory is EXACTLY the
+    uninterrupted one, not merely close."""
+
+    @staticmethod
+    def _make_step(seed):
+        from paddle_tpu.optimizer import lr as lr_mod
+        paddle.seed(seed)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Dropout(0.5), nn.Linear(16, 4))
+        sched = lr_mod.MultiStepDecay(learning_rate=1e-2, milestones=[2, 4])
+        opt = optimizer.AdamW(learning_rate=sched, weight_decay=0.01)
+
+        def loss_fn(m, b):
+            d = m(b["x"]) - b["y"]
+            return (d * d).mean()
+
+        return CompiledTrainStep(net, loss_fn, opt, seed=0)
+
+    @staticmethod
+    def _data(n):
+        rng = np.random.default_rng(11)
+        return [{"x": rng.normal(size=(4, 8)).astype(np.float32),
+                 "y": rng.normal(size=(4, 4)).astype(np.float32)}
+                for _ in range(n)]
+
+    def test_exact_resume(self, tmp_path):
+        batches = self._data(6)
+        ref_step = self._make_step(1)
+        ref = [float(ref_step(b)) for b in batches]
+
+        step_a = self._make_step(1)
+        for b in batches[:3]:
+            step_a(b)
+        assert step_a._step_count == 3
+        step_a.save_checkpoint(str(tmp_path / "ck"),
+                               extra_state={"note": "mid-run"})
+
+        step_b = self._make_step(9)       # different init — overwritten
+        extra = step_b.load_checkpoint(str(tmp_path / "ck"))
+        assert extra == {"note": "mid-run"}
+        assert step_b._step_count == 3
+        assert step_b.optimizer._lr_scheduler.last_epoch == \
+            step_a.optimizer._lr_scheduler.last_epoch
+        resumed = [float(step_b(b)) for b in batches[3:]]
+        # bit-identical, not allclose: same program, same state, same
+        # RNG stream
+        assert resumed == ref[3:]

@@ -1,0 +1,292 @@
+"""Trainer chaos harness (ISSUE 7 tentpole e).
+
+PR 6 built the chaos-injection culture for serving (FaultPlan,
+no-lost-request); this module brings it to training: crash schedules at
+every save point — mid-step (between saves), mid-chunk torn write,
+pre-manifest, between manifest and commit rename, post-commit — assert
+the two crash-safety invariants:
+
+1. ``auto_resume`` ALWAYS lands on a valid checkpoint (a torn save is
+   never visible; the previous checkpoint survives intact);
+2. the resumed loss trajectory is bit-identical to an uninterrupted run,
+   on both the single-chip ``CompiledTrainStep`` and the sharded
+   ``ShardedTrainStep`` paths (including resuming a sharded checkpoint
+   on a single chip via reshard-on-load).
+
+Fast tests crash in-process (``ChaosCrash``); the real-SIGKILL
+subprocess soak (``os._exit`` at the scheduled byte offset) is
+``slow``-marked to protect the tier-1 budget on the 1-core box.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.checkpoint import (ChaosCrash, clear_chaos,
+                                               set_chaos,
+                                               validate_checkpoint)
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+from paddle_tpu.distributed.trainer import ShardedTrainStep
+from paddle_tpu.jit.train import CompiledTrainStep
+
+from helpers import make_strategy
+
+POINTS = ("mid-chunk", "pre-manifest", "pre-rename")
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    clear_chaos()
+
+
+def _loss_fn(m, b):
+    d = m(b["x"]) - b["y"]
+    return (d * d).mean()
+
+
+def _mlp_step(seed=1, sharded=False):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.AdamW(learning_rate=1e-2)
+    if sharded:
+        return ShardedTrainStep(net, _loss_fn, opt, stage=1, seed=0)
+    return CompiledTrainStep(net, _loss_fn, opt, seed=0)
+
+
+def _data(n):
+    rng = np.random.default_rng(5)
+    return [{"x": rng.normal(size=(4, 8)).astype(np.float32),
+             "y": rng.normal(size=(4, 4)).astype(np.float32)}
+            for _ in range(n)]
+
+
+class TestInProcessChaosSingleChip:
+    @pytest.mark.parametrize("point", POINTS)
+    def test_torn_save_resumes_from_previous_bit_identical(
+            self, tmp_path, point):
+        batches = _data(6)
+        ref_step = _mlp_step()
+        ref = [float(ref_step(b)) for b in batches]
+
+        m = CheckpointManager(str(tmp_path / "ck"))
+        step = _mlp_step()
+        for i, b in enumerate(batches[:4]):
+            step(b)
+            if i + 1 == 2:
+                m.save(step, 2)
+        set_chaos(point)
+        with pytest.raises(ChaosCrash):
+            m.save(step, 4)
+
+        # "fresh process": a new manager + differently-seeded step
+        m2 = CheckpointManager(str(tmp_path / "ck"))
+        step2 = _mlp_step(seed=9)
+        got = m2.restore(step2)
+        assert got is not None and got[0] == 2
+        validate_checkpoint(m2.step_dir(2))
+        resumed = [float(step2(b)) for b in batches[2:]]
+        assert resumed == ref[2:]          # bit-identical, not allclose
+        assert not [d for d in os.listdir(tmp_path / "ck")
+                    if ".tmp-" in d]
+
+    def test_post_commit_crash_resumes_from_new_checkpoint(self, tmp_path):
+        batches = _data(6)
+        ref_step = _mlp_step()
+        ref = [float(ref_step(b)) for b in batches]
+
+        m = CheckpointManager(str(tmp_path / "ck"))
+        step = _mlp_step()
+        for i, b in enumerate(batches[:4]):
+            step(b)
+            if i + 1 == 2:
+                m.save(step, 2)
+        set_chaos("post-commit")
+        with pytest.raises(ChaosCrash):
+            m.save(step, 4)      # commit already landed — save is valid
+
+        m2 = CheckpointManager(str(tmp_path / "ck"))
+        step2 = _mlp_step(seed=9)
+        got = m2.restore(step2)
+        assert got is not None and got[0] == 4
+        resumed = [float(step2(b)) for b in batches[4:]]
+        assert resumed == ref[4:]
+
+    def test_mid_step_crash_loses_nothing_saved(self, tmp_path):
+        """The 'kill mid-step' schedule: a crash BETWEEN saves (no save
+        in flight) resumes from the last checkpoint exactly."""
+        batches = _data(6)
+        ref_step = _mlp_step()
+        ref = [float(ref_step(b)) for b in batches]
+
+        m = CheckpointManager(str(tmp_path / "ck"))
+        step = _mlp_step()
+        for i, b in enumerate(batches[:3]):   # dies "mid" step 4
+            step(b)
+            if i + 1 == 2:
+                m.save(step, 2)
+
+        m2 = CheckpointManager(str(tmp_path / "ck"))
+        step2 = _mlp_step(seed=9)
+        assert m2.restore(step2)[0] == 2
+        resumed = [float(step2(b)) for b in batches[2:]]
+        assert resumed == ref[2:]
+
+
+class TestInProcessChaosSharded:
+    @pytest.mark.parametrize("point", ("mid-chunk", "pre-rename"))
+    def test_torn_sharded_save_resumes_bit_identical(self, tmp_path, point):
+        batches = _data(6)
+        fleet.init(strategy=make_strategy(dp=2))
+        ref_step = _mlp_step(sharded=True)
+        ref = [float(ref_step(b)) for b in batches]
+
+        fleet.reset()
+        fleet.init(strategy=make_strategy(dp=2))
+        m = CheckpointManager(str(tmp_path / "ck"))
+        step = _mlp_step(sharded=True)
+        for i, b in enumerate(batches[:4]):
+            step(b)
+            if i + 1 == 2:
+                m.save(step, 2)
+        set_chaos(point)
+        with pytest.raises(ChaosCrash):
+            m.save(step, 4)
+
+        # resume on the SAME mesh shape: bit-identical
+        fleet.reset()
+        fleet.init(strategy=make_strategy(dp=2))
+        m2 = CheckpointManager(str(tmp_path / "ck"))
+        step2 = _mlp_step(seed=9, sharded=True)
+        got = m2.restore(step2)
+        assert got is not None and got[0] == 2
+        assert step2._step_count == 2
+        resumed = [float(step2(b)) for b in batches[2:]]
+        assert resumed == ref[2:]
+
+    def test_torn_sharded_save_resumes_on_single_chip(self, tmp_path):
+        """Kill during a 2-way sharded save, then resume the surviving
+        checkpoint on ONE chip (reshard-on-load): elastic recovery when
+        the restart got different hardware."""
+        batches = _data(6)
+        fleet.init(strategy=make_strategy(dp=2))
+        ref_step = _mlp_step(sharded=True)
+        ref = [float(ref_step(b)) for b in batches]
+
+        fleet.reset()
+        fleet.init(strategy=make_strategy(dp=2))
+        m = CheckpointManager(str(tmp_path / "ck"))
+        step = _mlp_step(sharded=True)
+        for i, b in enumerate(batches[:4]):
+            step(b)
+            if i + 1 == 2:
+                m.save(step, 2)
+        set_chaos("pre-rename")
+        with pytest.raises(ChaosCrash):
+            m.save(step, 4)
+
+        fleet.reset()
+        m2 = CheckpointManager(str(tmp_path / "ck"))
+        step2 = _mlp_step(seed=9, sharded=False)
+        got = m2.restore(step2)
+        assert got is not None and got[0] == 2
+        resumed = [float(step2(b)) for b in batches[2:]]
+        # cross-mesh: reduction order differs — tight but not bitwise
+        np.testing.assert_allclose(resumed, ref[2:], rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL (os._exit) subprocess soak — slow
+# ---------------------------------------------------------------------------
+
+def _read_losses(path):
+    """{step: loss}, keeping the LAST occurrence per step (a resumed run
+    replays the steps after its restore point)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+@pytest.mark.slow
+class TestKillChaosSoak:
+    @pytest.mark.parametrize("point", POINTS)
+    def test_sigkill_schedule_resumes_bit_identical(self, tmp_path, point):
+        repo = Path(__file__).resolve().parent.parent
+        worker = str(Path(__file__).with_name("ckpt_chaos_worker.py"))
+        env = os.environ.copy()
+        env["PYTHONPATH"] = str(repo)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_TPU_CKPT_CHAOS", None)
+
+        def run(mode, chaos=None, expect=0):
+            e = dict(env)
+            if chaos:
+                e["PADDLE_TPU_CKPT_CHAOS"] = chaos
+            p = subprocess.run(
+                [sys.executable, worker, mode, str(tmp_path), "8", "2"],
+                env=e, capture_output=True, text=True, timeout=300)
+            assert p.returncode == expect, (p.stdout[-500:],
+                                            p.stderr[-2000:])
+            return p
+
+        run("ref")
+        # the 2nd save (after step 4) dies at the scheduled point with
+        # a REAL process kill — no atexit, no cleanup
+        run("run", chaos=f"{point}:2:exit", expect=17)
+        # the restart must auto-resume from a valid checkpoint and finish
+        run("run")
+        ref = _read_losses(tmp_path / "losses_ref.jsonl")
+        got = _read_losses(tmp_path / "losses_run.jsonl")
+        assert set(got) == set(ref) == set(range(1, 9))
+        assert got == ref              # bit-identical per step
+
+
+# ---------------------------------------------------------------------------
+# tier-1 budget guard (ROADMAP 870 s, 1-core box)
+# ---------------------------------------------------------------------------
+
+def test_tier1_budget_guard():
+    """The kill-based soaks fork a jax-importing subprocess per run —
+    they must stay behind ``slow``; the fast chaos/manager footprint
+    stays bounded; and the conftest leak guards (staging dirs, writer
+    threads) stay in place."""
+    here = Path(__file__).resolve().parent
+    src = (here / "test_ckpt_chaos.py").read_text()
+    m = re.search(r"((?:@[\w.]+(?:\(.*?\))?\s*\n)*)class TestKillChaosSoak",
+                  src)
+    assert m and "pytest.mark.slow" in m.group(1), (
+        "TestKillChaosSoak must be @pytest.mark.slow")
+    n_fast = 0
+    for fname in ("test_ckpt_chaos.py", "test_ckpt_manager.py"):
+        body = (here / fname).read_text()
+        for mm in re.finditer(r"((?:@[\w.]+(?:\(.*?\))?\s*\n)*)"
+                              r"    def (test_\w+)\(|^def (test_\w+)\(",
+                              body, re.M):
+            deco = mm.group(1) or ""
+            if "pytest.mark.slow" not in deco:
+                n_fast += 1
+    # class-level slow marks cover their methods; subtract the soak's
+    n_fast -= len(POINTS)
+    assert n_fast <= 32, (
+        f"{n_fast} fast checkpoint-chaos/manager tests — move heavy ones "
+        f"behind @pytest.mark.slow to protect the 870 s tier-1 budget")
+    conftest = (here / "conftest.py").read_text()
+    assert "staging_dirs_alive" in conftest, (
+        "conftest must fail tests that leak *.tmp-* checkpoint staging "
+        "dirs")
+    assert "paddle-tpu-" in conftest, (
+        "conftest thread guard must keep catching paddle-tpu-named "
+        "writer threads")
+    roadmap = (here.parent / "ROADMAP.md").read_text()
+    assert "not slow" in roadmap and "870" in roadmap

@@ -1,0 +1,372 @@
+"""CheckpointManager + crash-safe hapi ``fit`` tests.
+
+The contracts under test (ISSUE 7 tentpole c/d):
+
+- retention: keep-last-N always, keep-every-K pins rollback points;
+- bounded async save queue whose failures SURFACE (next save / wait);
+- ``auto_resume``/``restore`` land on the latest *valid* checkpoint,
+  falling back past corrupt ones (counted);
+- SIGTERM flips the preemption flag; ``fit`` saves and stops cleanly;
+- ``fit(checkpoint_dir=, save_steps=, auto_resume=True)`` with a
+  ``CheckpointableLoader`` resumes bit-identically mid-epoch.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.common.errors import CorruptCheckpointError
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import (ChaosCrash, clear_chaos,
+                                               set_chaos)
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io.dataloader import CheckpointableLoader, Dataset
+from paddle_tpu.jit.train import CompiledTrainStep
+from paddle_tpu.observability import get_registry
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    clear_chaos()
+
+
+def _tree(v):
+    return {"x": np.full(8, float(v), np.float32)}
+
+
+def _bitflip_first_chunk(path):
+    meta = ckpt.get_checkpoint_metadata(str(path))
+    entry = next(iter(meta["arrays"].values()))
+    f = os.path.join(str(path), entry["chunks"][0]["file"])
+    with open(f, "r+b") as fh:
+        fh.seek(-5, os.SEEK_END)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0x20]))
+
+
+class TestRetention:
+    def test_keep_last_n(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=2)
+        for s in range(1, 6):
+            m.save(_tree(s), s)
+        assert m.steps_on_disk() == [4, 5]
+
+    def test_keep_every_k_pins_rollback_points(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=1, keep_every_k=2)
+        for s in range(1, 6):
+            m.save(_tree(s), s)
+        # every 2nd step survives pruning alongside the newest
+        assert m.steps_on_disk() == [2, 4, 5]
+
+    def test_pruned_checkpoint_gone_latest_loads(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=1)
+        for s in (1, 2):
+            m.save(_tree(s), s)
+        out = ckpt.load_state_dict(_tree(0), m.step_dir(2))
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.full(8, 2.0))
+        assert not os.path.exists(m.step_dir(1))
+
+
+class TestAutoResume:
+    def test_empty_dir_returns_none(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        assert m.auto_resume() is None
+        assert m.restore(_tree(0)) is None
+
+    def test_latest_valid_wins(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_tree(1), 1)
+        m.save(_tree(2), 2)
+        assert m.auto_resume() == (2, m.step_dir(2))
+
+    def test_corrupt_latest_falls_back(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_tree(1), 1)
+        m.save(_tree(2), 2)
+        _bitflip_first_chunk(m.step_dir(2))
+        before = get_registry().counter("ckpt_corruption_total").value
+        assert m.auto_resume() == (1, m.step_dir(1))
+        assert get_registry().counter(
+            "ckpt_corruption_total").value == before + 1
+        # restore() takes the same fallback on the load path
+        tmpl = _tree(0)
+        got = m.restore(tmpl)
+        assert got == (1, None)
+        np.testing.assert_array_equal(np.asarray(tmpl["x"]),
+                                      np.full(8, 1.0))
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_tree(1), 1)
+        _bitflip_first_chunk(m.step_dir(1))
+        assert m.auto_resume() is None
+        assert m.restore(_tree(0)) is None
+
+    def test_gc_stale_sweeps_staging(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        dead = tmp_path / "step_00000007.tmp-deadbeef"
+        dead.mkdir()
+        (dead / "junk.npy").write_bytes(b"x")
+        swept = m.gc_stale()
+        assert [os.path.basename(p) for p in swept] == [dead.name]
+        assert not dead.exists()
+        # a staging dir is never mistaken for a checkpoint
+        assert m.steps_on_disk() == []
+
+
+class TestAsyncQueue:
+    def test_bounded_queue_commits_everything(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=5,
+                              async_save=True, max_inflight=1)
+        for s in range(1, 4):
+            h = m.save(_tree(s), s)
+            assert h is not None
+        m.wait()
+        assert m.steps_on_disk() == [1, 2, 3]
+        assert get_registry().gauge("ckpt_async_queue_depth").value == 0
+        for s in range(1, 4):
+            ckpt.validate_checkpoint(m.step_dir(s))
+
+    def test_failed_background_save_surfaces_at_next_save(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=True)
+        set_chaos("pre-rename")
+        h = m.save(_tree(1), 1)
+        deadline = time.monotonic() + 60
+        while not h.done() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(ChaosCrash):
+            m.save(_tree(2), 2)
+        # after surfacing, the manager recovers: save + wait succeed
+        m.gc_stale()
+        m.save(_tree(3), 3)
+        m.wait()
+        assert 3 in m.steps_on_disk()
+
+    def test_wait_surfaces_failure(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=True)
+        set_chaos("pre-rename")
+        m.save(_tree(1), 1)
+        with pytest.raises(ChaosCrash):
+            m.wait()
+        m.gc_stale()
+
+
+class TestPreemptionHook:
+    def test_sigterm_sets_flag_and_restores_handler(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        calls = []
+        prev = signal.getsignal(signal.SIGTERM)
+        m.install_preemption_hook(on_preempt=lambda: calls.append(1))
+        try:
+            assert m.preempted is False
+            signal.raise_signal(signal.SIGTERM)
+            assert m.preempted is True
+            assert calls == [1]
+        finally:
+            m.uninstall_preemption_hook()
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ---------------------------------------------------------------------------
+# hapi fit: checkpoint_dir / save_steps / auto_resume
+# ---------------------------------------------------------------------------
+
+class _ArrDataset(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(23)
+        self.x = rng.normal(size=(n, 6)).astype(np.float32)
+        self.y = rng.normal(size=(n, 3)).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _LossHistory(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(np.asarray(logs["loss"])))
+
+
+class _StopAfter(Callback):
+    def __init__(self, n):
+        super().__init__()
+        self.n = n
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen >= self.n:
+            self.model.stop_training = True
+
+
+class _RaiseSigterm(Callback):
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen == self.at:
+            signal.raise_signal(signal.SIGTERM)
+
+
+def _make_model(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
+    model = paddle.Model(net)
+    model.prepare(optimizer.AdamW(learning_rate=5e-3), nn.MSELoss())
+    return model
+
+
+def _make_loader():
+    return CheckpointableLoader(_ArrDataset(), batch_size=4, shuffle=True,
+                                seed=7)
+
+
+class TestCheckpointableLoader:
+    def test_deterministic_order_and_len(self):
+        a, b = _make_loader(), _make_loader()
+        ba = [np.asarray(x[0].value) for x in a]
+        bb = [np.asarray(x[0].value) for x in b]
+        assert len(ba) == len(a) == 8
+        for u, v in zip(ba, bb):
+            np.testing.assert_array_equal(u, v)
+        # next epoch reshuffles (same loader, new epoch)
+        ba2 = [np.asarray(x[0].value) for x in a]
+        assert not all(np.array_equal(u, v) for u, v in zip(ba, ba2))
+
+    def test_state_roundtrip_skips_without_materializing(self):
+        a = _make_loader()
+        it = iter(a)
+        consumed = [next(it) for _ in range(3)]
+        state = a.state_dict()
+        assert state == {"epoch": 0, "next_batch": 3, "seed": 7,
+                         "shuffle": True, "batch_size": 4}
+        # a fresh loader fast-forwarded to the state yields the SAME
+        # remaining batches, and never touches the skipped indices
+        b = _make_loader()
+        touched = []
+        orig = b.dataset.__class__.__getitem__
+
+        def spy(ds, i):
+            touched.append(i)
+            return orig(ds, i)
+
+        b.dataset.__class__.__getitem__ = spy
+        try:
+            b.set_state_dict(state)
+            rest_b = [np.asarray(x[0].value) for x in b]
+        finally:
+            b.dataset.__class__.__getitem__ = orig
+        rest_a = [np.asarray(x[0].value) for x in it]
+        assert len(rest_b) == len(rest_a) == 5
+        for u, v in zip(rest_a, rest_b):
+            np.testing.assert_array_equal(u, v)
+        assert len(consumed) == 3
+        assert len(touched) == 5 * 4   # only the remaining 5 batches
+
+    def test_config_mismatch_rejected(self):
+        a = _make_loader()
+        with pytest.raises(Exception):
+            a.set_state_dict({"epoch": 0, "next_batch": 1, "seed": 99,
+                              "shuffle": True, "batch_size": 4})
+
+
+class TestFitCrashSafe:
+    def test_exact_resume_mid_epoch(self, tmp_path):
+        # uninterrupted reference: 2 epochs, loss per batch
+        ref_hist = _LossHistory()
+        _make_model(1).fit(_make_loader(), epochs=2, verbose=0,
+                           callbacks=[ref_hist])
+        assert len(ref_hist.losses) == 16
+
+        # interrupted run: checkpoint every 3 steps, killed after 5
+        hist_a = _LossHistory()
+        _make_model(1).fit(
+            _make_loader(), epochs=2, verbose=0,
+            callbacks=[hist_a, _StopAfter(5)],
+            checkpoint_dir=str(tmp_path / "ck"), save_steps=3)
+        assert hist_a.losses == ref_hist.losses[:5]
+
+        # resume in a "fresh process": different init seed, new loader;
+        # auto_resume restores params/opt/RNG/loader position — the
+        # remaining trajectory is BIT-identical to the uninterrupted run
+        hist_b = _LossHistory()
+        _make_model(9).fit(
+            _make_loader(), epochs=2, verbose=0, callbacks=[hist_b],
+            checkpoint_dir=str(tmp_path / "ck"), save_steps=3,
+            auto_resume=True)
+        assert hist_b.losses == ref_hist.losses[5:]
+
+    def test_resume_after_completion_is_noop(self, tmp_path):
+        hist = _LossHistory()
+        _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[hist],
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           save_steps=4)
+        hist2 = _LossHistory()
+        _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[hist2],
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           save_steps=4, auto_resume=True)
+        assert hist2.losses == []
+
+    def test_sigterm_preemption_saves_and_resumes(self, tmp_path):
+        manager = CheckpointManager(str(tmp_path / "ck"))
+        manager.install_preemption_hook()
+        try:
+            ref_hist = _LossHistory()
+            _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                               callbacks=[ref_hist])
+
+            hist_a = _LossHistory()
+            _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                               callbacks=[hist_a, _RaiseSigterm(3)],
+                               checkpoint_dir=manager)
+            # SIGTERM after batch 3: saved + stopped cleanly
+            assert len(hist_a.losses) == 3
+            assert manager.steps_on_disk() == [3]
+        finally:
+            manager.uninstall_preemption_hook()
+
+        manager2 = CheckpointManager(str(tmp_path / "ck"))
+        hist_b = _LossHistory()
+        _make_model(9).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[hist_b], checkpoint_dir=manager2)
+        assert hist_b.losses == ref_hist.losses[3:]
+
+    def test_resume_falls_back_past_corrupt_latest(self, tmp_path):
+        ref_hist = _LossHistory()
+        _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[ref_hist])
+
+        _make_model(1).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[_StopAfter(6)],
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           save_steps=3)
+        m = CheckpointManager(str(tmp_path / "ck"), keep_last_n=5)
+        assert m.steps_on_disk() == [3, 6]
+        _bitflip_first_chunk(m.step_dir(6))
+
+        # auto_resume skips the torn step-6 checkpoint, resumes from 3:
+        # batches 4..6 are REPLAYED exactly, then the tail continues
+        hist_b = _LossHistory()
+        _make_model(9).fit(_make_loader(), epochs=1, verbose=0,
+                           callbacks=[hist_b],
+                           checkpoint_dir=str(tmp_path / "ck"),
+                           save_steps=3)
+        assert hist_b.losses == ref_hist.losses[3:]
