@@ -14,9 +14,10 @@ Usage:
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..common.errors import enforce
@@ -32,7 +33,8 @@ __all__ = ["ShardedTrainStep"]
 class ShardedTrainStep(CompiledTrainStep):
     def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
                  stage: Optional[int] = None, seed: int = 0,
-                 donate: bool = True):
+                 donate: bool = True, fused_step: bool = True,
+                 grad_bucket_mb: float = 4.0):
         hcg = get_hybrid_communicate_group()
         enforce(hcg is not None, "fleet.init() before ShardedTrainStep")
         self.mesh = hcg.mesh
@@ -42,11 +44,93 @@ class ShardedTrainStep(CompiledTrainStep):
                 strat = get_strategy()
                 stage = strat.sharding_configs.stage if (strat and
                                                          strat.sharding) else 1
-        super().__init__(model, loss_fn, optimizer, seed=seed, donate=donate)
+        super().__init__(model, loss_fn, optimizer, seed=seed, donate=donate,
+                         fused_step=fused_step)
+        # packing flat per-dtype update buffers would concatenate leaves
+        # with DIFFERENT shardings (stage>=2 shards moments/params) and
+        # force a GSPMD gather — the sharded fused path keeps per-leaf
+        # updates (same fused math, collectives stay where GSPMD put them)
+        self._fused_pack_small = False
+        # bucketed data-parallel gradient reduction (see _sync_grads);
+        # 0 disables
+        self._bucket_bytes = int(grad_bucket_mb * 2**20)
+        self._bucket_plan: Optional[List[List[int]]] = None
         self.plan = ShardingPlan(model, self.mesh, stage=stage)
         # place initial state onto the mesh
         self.state = jax.tree_util.tree_map(
             jax.device_put, self.state, self.plan.state_shardings(self.state))
+
+    # -- bucketed gradient collectives ---------------------------------------
+    def grad_buckets(self) -> List[List[int]]:
+        """The static bucket plan: a list of buckets, each a list of
+        indices into the flattened params/grads tree.  Only FULLY
+        REPLICATED grads participate — those are the data-parallel
+        gradients whose cross-replica sum needs an all-reduce; sharded
+        (TP/FSDP) grads are already local to their shard and pass
+        through untouched.  Leaves pack into a bucket in flatten order
+        while they share a dtype and the running size stays within the
+        budget; a single leaf larger than the whole budget gets a
+        bucket of its own."""
+        if self._bucket_plan is not None:
+            return self._bucket_plan
+        shardings = self.plan.state_shardings(self.state)["params"]
+        flat_p = jax.tree_util.tree_leaves(self.state["params"])
+        flat_sh = jax.tree_util.tree_leaves(shardings)
+        plan: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        cur_dt = None
+        for i, (p, sh) in enumerate(zip(flat_p, flat_sh)):
+            if not getattr(sh, "is_fully_replicated", False):
+                continue
+            nbytes = p.size * p.dtype.itemsize
+            if nbytes >= self._bucket_bytes:
+                plan.append([i])        # giant leaf: its own bucket
+                continue
+            if cur and (cur_dt != p.dtype
+                        or cur_bytes + nbytes > self._bucket_bytes):
+                plan.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_dt = p.dtype
+            cur_bytes += nbytes
+        if cur:
+            plan.append(cur)
+        self._bucket_plan = plan
+        return plan
+
+    def _sync_grads(self, grads):
+        """Bucketed data-parallel gradient reduction — the GSPMD analog
+        of DDP gradient bucketing.  Each bucket's replicated grads are
+        packed into one flat vector and pinned replicated with ONE
+        with_sharding_constraint, so the partitioner emits one fused
+        all-reduce per size-bounded bucket instead of one tiny
+        collective per leaf (or one giant one after the whole
+        backward).  Every bucket depends only on its own leaves, so its
+        reduce is issued as soon as backward has produced them and
+        XLA's latency-hiding scheduler overlaps it with the remaining
+        backward compute.  Values are untouched (concat → constraint →
+        split is an identity), so this composes bit-identically with
+        both the fused and the reference update paths."""
+        if not self._bucket_bytes:
+            return grads
+        from jax.sharding import NamedSharding, PartitionSpec
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        for bucket in self.grad_buckets():
+            if len(bucket) == 1:
+                i = bucket[0]
+                flat_g[i] = jax.lax.with_sharding_constraint(flat_g[i],
+                                                             repl)
+                continue
+            vec = jnp.concatenate([flat_g[i].reshape(-1) for i in bucket])
+            vec = jax.lax.with_sharding_constraint(vec, repl)
+            off = 0
+            for i in bucket:
+                n = flat_g[i].size
+                flat_g[i] = vec[off:off + n].reshape(flat_g[i].shape)
+                off += n
+        return jax.tree_util.tree_unflatten(treedef, flat_g)
 
     def _build(self):
         # same fused step as the parent, jitted with explicit state

@@ -55,7 +55,11 @@ class Model:
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, fused_step: bool = True):
+        # fused_step: run the compiled step's optimizer update through
+        # the fused clip+update path (jit/train.py; bit-identical to
+        # False, which keeps the per-leaf reference loop for debugging)
+        self._fused_step = bool(fused_step)
         self._optimizer = optimizer
         if loss is not None:
             enforce(callable(loss), "loss must be callable (a Layer or fn)")
@@ -110,13 +114,15 @@ class Model:
             # with metrics configured, the fused step also returns the
             # training forward's predictions (has_aux) so per-batch
             # train metrics cost no extra forward
+            fused = getattr(self, "_fused_step", True)
             if self._metrics:
                 self._train_step = CompiledTrainStep(
                     self.network, self._loss_fn_aux, self._optimizer,
-                    has_aux=True)
+                    has_aux=True, fused_step=fused)
             else:
                 self._train_step = CompiledTrainStep(
-                    self.network, self._loss_fn, self._optimizer)
+                    self.network, self._loss_fn, self._optimizer,
+                    fused_step=fused)
             if self._pending_opt_state is not None:
                 self._train_step.state["opt"] = self._pending_opt_state
                 self._pending_opt_state = None

@@ -69,7 +69,8 @@ class CompiledTrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
                  seed: int = 0, donate: bool = True,
-                 state_sharding_fn=None, has_aux: bool = False):
+                 state_sharding_fn=None, has_aux: bool = False,
+                 fused_step: bool = True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -84,6 +85,20 @@ class CompiledTrainStep:
         self._step_fn = None
         self._donate = donate
         self._has_aux = has_aux
+        # fused step regions (default on): the optimizer update runs
+        # through Optimizer.apply_gradients_fused — global-norm clip
+        # folded into one pass over each param/grad/slot triple, Pallas
+        # kernel on TPU.  Bit-identical to fused_step=False by
+        # construction (ops/pallas/fused_train.py), still ONE compiled
+        # program per step path (step_compiles() asserts it).
+        self._fused_step = fused_step
+        # small-leaf packing: None = auto (on only when the Pallas
+        # kernels are active, where it amortizes the tail's kernel
+        # launches).  Off the kernel path the per-leaf fused program is
+        # STRUCTURALLY the unfused program, which is what guarantees
+        # bitwise parity — packing reshapes XLA's fusion clusters and
+        # CPU codegen may contract FMAs differently at the last ulp.
+        self._fused_pack_small: Optional[bool] = None
         self._timer = None
         self._flops_cache = None
         # optimizer-update count (fused __call__ + apply_grads); part of
@@ -99,10 +114,20 @@ class CompiledTrainStep:
 
     def step_flops(self, batch) -> Optional[float]:
         """Estimated FLOPs of one fused step from XLA's cost model
-        (for MFU).  Cached after the first call; returns None when the
-        backend's cost analysis is unavailable.  Note: this AOT-lowers
-        the step once more (the dispatch-path executable is cached
-        separately), so callers should ask once, not per step."""
+        (the MFU numerator).  Cached after the first call; returns None
+        when the backend's cost analysis is unavailable.  Note: this
+        AOT-lowers the step once more (the dispatch-path executable is
+        cached separately), so callers should ask once, not per step.
+
+        Accounting: the step program contains fwd + bwd + grad-clip +
+        optimizer update, so the cost model already counts the clip and
+        update FLOPs whenever they lower to HLO — including the
+        fused_step=True reference path off TPU.  When the update runs
+        inside the Pallas fused kernel (TPU), those FLOPs are opaque to
+        cost analysis, so the optimizer's analytic estimate
+        (``Optimizer.update_flop_estimate``) is added back.  Pre- and
+        post-fusion MFU therefore use the same denominator convention
+        and stay comparable across BENCH rounds."""
         if self._flops_cache is not None:
             return self._flops_cache if self._flops_cache > 0 else None
         if self._step_fn is None:
@@ -120,12 +145,51 @@ class CompiledTrainStep:
             flops = float(cost.get("flops", -1.0))
         except Exception:
             flops = -1.0
+        if flops > 0 and self._fused_step:
+            from ..ops.pallas import fused_train as FT
+            if FT.kernels_active():
+                # the kernel path hides the update from the cost model
+                flops += self.optimizer.update_flop_estimate(
+                    self.state["params"])
         self._flops_cache = flops if flops > 0 else -1.0
         return flops if flops > 0 else None
 
+    def step_compiles(self) -> int:
+        """Number of compiled executables behind the fused step path —
+        the one-program-per-step invariant (0 before the first step;
+        a second compile means a shape/dtype leak into the trace)."""
+        if self._step_fn is None:
+            return 0
+        try:
+            return int(self._step_fn._cache_size())
+        except Exception:
+            return 1
+
+    def _apply_gradients_fn(self):
+        """(params, grads, opt_state, lr) -> (params, opt_state): the
+        fused or per-leaf reference update, per the fused_step knob."""
+        optimizer = self.optimizer
+        if self._fused_step:
+            pack = self._fused_pack_small
+            if pack is None:
+                from ..ops.pallas import fused_train as FT
+                pack = FT.kernels_active()
+            return lambda p, g, s, lr: optimizer.apply_gradients_fused(
+                p, g, s, lr=lr, pack_small=pack)
+        return lambda p, g, s, lr: optimizer.apply_gradients(
+            p, g, s, lr=lr)
+
+    def _sync_grads(self, grads):
+        """Hook between backward and the optimizer update — identity
+        here; ShardedTrainStep overrides it with bucketed gradient
+        collectives so communication overlaps backward compute."""
+        return grads
+
     def _make_step(self):
         """The raw (un-jitted) fused step fn: fwd+bwd+clip+update."""
-        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+        model, loss_fn = self.model, self.loss_fn
+        apply_gradients = self._apply_gradients_fn()
+        sync_grads = self._sync_grads
 
         has_aux = self._has_aux
 
@@ -143,8 +207,9 @@ class CompiledTrainStep:
             else:
                 loss, grads = jax.value_and_grad(pure_loss)(
                     state["params"])
-            new_params, new_opt = optimizer.apply_gradients(
-                state["params"], grads, state["opt"], lr=lr)
+            grads = sync_grads(grads)
+            new_params, new_opt = apply_gradients(
+                state["params"], grads, state["opt"], lr)
             out = (loss, aux) if has_aux else loss
             return {"params": new_params, "opt": new_opt}, out
 
@@ -211,11 +276,11 @@ class CompiledTrainStep:
     def apply_grads(self, grads):
         """Optimizer update from externally-computed (accumulated) grads."""
         if not hasattr(self, "_apply_fn"):
-            optimizer = self.optimizer
+            apply_gradients = self._apply_gradients_fn()
 
             def apply(state, grads, lr):
-                new_params, new_opt = optimizer.apply_gradients(
-                    state["params"], grads, state["opt"], lr=lr)
+                new_params, new_opt = apply_gradients(
+                    state["params"], grads, state["opt"], lr)
                 return {"params": new_params, "opt": new_opt}
 
             # donate the old state like the fused path — without it the

@@ -51,6 +51,11 @@ class LlamaConfig:
     attention_bias: bool = False     # qkv/o biases (Qwen2-family True)
     rope_interleaved: bool = False   # GPT-J pairing (ERNIE-4.5 True)
     fuse_qkv: bool = False           # single qkv matmul (concat weights)
+    # fused step regions (ops/pallas/fused_train): rope applied in the
+    # q/k projections' output write + residual-add fused into the
+    # post-attention RMSNorm.  Bit-identical to False (the unfused
+    # chain) — kernels engage on TPU only
+    fuse_norm_rope: bool = True
     use_flash_attention: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
@@ -181,10 +186,29 @@ class LlamaAttention(Layer):
         self.use_flash = config.use_flash_attention
         self.rope_interleaved = getattr(config, "rope_interleaved", False)
         self.fuse_qkv = getattr(config, "fuse_qkv", False)
+        self.fuse_norm_rope = getattr(config, "fuse_norm_rope", True)
 
     def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         b, s, _ = x.shape
-        if self.fuse_qkv:
+        cos, sin = cos_sin
+        # fused chain needs raw projection weights: a quantize_model'd
+        # attention (QuantizedLinear: qweight+scales, no .weight) takes
+        # the module-call path below
+        fuse_rope = (self.fuse_norm_rope and not self.fuse_qkv
+                     and getattr(self.q_proj, "bias", None) is None
+                     and all(getattr(p, "weight", None) is not None
+                             for p in (self.q_proj, self.k_proj,
+                                       self.v_proj)))
+        if fuse_rope:
+            # fused rotary→QKV chain: rope rides the projection's output
+            # write (one pass per projection on TPU; bit-identical jnp
+            # composition elsewhere)
+            q, k, v = F.qkv_rope(
+                x, self.q_proj.weight, self.k_proj.weight,
+                self.v_proj.weight, cos, sin, n_heads=self.num_heads,
+                n_kv=self.num_kv_heads, head_dim=self.head_dim,
+                interleaved=self.rope_interleaved)
+        elif self.fuse_qkv:
             # one [H, (nh+2*nkv)*hd] matmul: the weight concat is cheap
             # relative to the fused MXU pass (weights stay separate
             # Parameters for checkpoint/TP-spec compatibility)
@@ -210,9 +234,10 @@ class LlamaAttention(Layer):
                           [b, s, self.num_kv_heads, self.head_dim])
             v = P.reshape(self.v_proj(x),
                           [b, s, self.num_kv_heads, self.head_dim])
-        cos, sin = cos_sin
-        q, k = apply_rotary_pos_emb(q, k, cos, sin,
-                                    interleaved=self.rope_interleaved)
+        if not fuse_rope:
+            # the fused chain above already applied rope in-register
+            q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                        interleaved=self.rope_interleaved)
         attn_fn = (F.scaled_dot_product_attention if self.use_flash
                    else F.scaled_dot_product_attention_ref)
         if pos is not None:
@@ -271,22 +296,30 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
+        self._fuse_chain = getattr(config, "fuse_norm_rope", True)
+
+    def _post_attn(self, x, attn):
+        """residual-add + post-attention RMSNorm + MLP residual."""
+        if self._fuse_chain:
+            # fused residual→RMSNorm: the attn-residual write and the
+            # norm read share one pass (bit-identical to the unfused
+            # chain below)
+            x, hn = self.post_attention_layernorm.forward_residual(attn, x)
+            return x + self.mlp(hn)
+        x = x + attn
+        return x + self.mlp(self.post_attention_layernorm(x))
 
     def forward(self, x, cos_sin, cache=None, pos=None, prefill=False):
         if cache is not None:
             attn, new_cache = self.self_attn(self.input_layernorm(x),
                                              cos_sin, cache, pos=pos,
                                              prefill=prefill)
-            x = x + attn
-            x = x + self.mlp(self.post_attention_layernorm(x))
-            return x, new_cache
+            return self._post_attn(x, attn), new_cache
         attn = self.self_attn(self.input_layernorm(x), cos_sin)
         # named residual for selective remat (recompute_granularity
         # "core_attn": keep the flash output, recompute the cheap rest)
         attn = apply_op(_ckpt_name_attn, attn)
-        x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        return self._post_attn(x, attn)
 
 
 class LlamaModel(Layer):

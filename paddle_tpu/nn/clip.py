@@ -13,7 +13,19 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
-           "clip_grad_value_", "clip_grad_norm_"]
+           "clip_grad_value_", "clip_grad_norm_", "global_norm_sq_f32"]
+
+
+def global_norm_sq_f32(leaves):
+    """Sum of squared L2 norms over grad leaves, with BOTH the squaring
+    and the accumulation in f32 regardless of leaf dtype (bf16's 8
+    mantissa bits saturate a running sum at ~256 — a bf16-accumulated
+    global norm silently under-reports on any real model).  Single
+    definition shared by ClipGradByGlobalNorm (the unfused reference
+    path) and Optimizer.apply_gradients_fused (the fused-step norm
+    pass) so the two can never drift — tests/test_fused_train.py pins
+    the bf16 regression."""
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
 
 
 class ClipGradBase:
@@ -71,9 +83,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
         leaves = jax.tree_util.tree_leaves(grads_tree)
         if not leaves:
             return grads_tree
-        gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                       for g in leaves)
-        gnorm = jnp.sqrt(gnorm_sq)
+        gnorm = jnp.sqrt(global_norm_sq_f32(leaves))
         scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
         return jax.tree_util.tree_map(
             lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
@@ -81,8 +91,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     def global_norm(self, grads_tree):
         leaves = jax.tree_util.tree_leaves(grads_tree)
-        return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                            for g in leaves))
+        return jnp.sqrt(global_norm_sq_f32(leaves))
 
 
 def clip_grad_value_(parameters, clip_value):

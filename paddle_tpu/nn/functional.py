@@ -159,3 +159,34 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
                                        is_causal=causal, training=training)
     return (out, None) if return_softmax else out
+
+
+# -- fused step regions (ops/pallas/fused_train) ------------------------------
+
+def add_rms_norm(x, residual, weight, epsilon=1e-6):
+    """Fused ``h = residual + x; y = rms_norm(h, weight)``; returns
+    ``(h, y)``.  One VMEM pass on TPU, bit-identical jnp composition
+    elsewhere — the residual→RMSNorm chain of every pre-norm decoder
+    block (RMSNorm.forward_residual routes here)."""
+    from ..ops.pallas.fused_train import add_rms_norm_raw
+    return apply_op(add_rms_norm_raw, x, residual, weight, epsilon=epsilon)
+
+
+def add_layer_norm(x, residual, weight, bias, epsilon=1e-5):
+    """Fused ``h = residual + x; y = layer_norm(h)`` over the last axis;
+    returns ``(h, y)`` (LayerNorm.forward_residual routes here)."""
+    from ..ops.pallas.fused_train import add_layer_norm_raw
+    return apply_op(add_layer_norm_raw, x, residual, weight, bias,
+                    epsilon=epsilon)
+
+
+def qkv_rope(x, wq, wk, wv, cos, sin, *, n_heads, n_kv, head_dim,
+             interleaved=False):
+    """The fused rotary→QKV chain: q/k projections with rope applied to
+    the matmul output tile in-register, v a plain projection.  Returns
+    ``(q, k, v)`` shaped [B, S, heads, head_dim] — bit-identical to the
+    unfused project→reshape→rope chain (models/llama.py routes here)."""
+    from ..ops.pallas.fused_train import qkv_rope_raw
+    return apply_op(qkv_rope_raw, x, wq, wk, wv, cos, sin,
+                    n_heads=n_heads, n_kv=n_kv, head_dim=head_dim,
+                    interleaved=interleaved)

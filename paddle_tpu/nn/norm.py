@@ -46,6 +46,17 @@ class LayerNorm(Layer):
         return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
                             self.epsilon)
 
+    def forward_residual(self, x, residual):
+        """Fused residual→norm chain: returns ``(h, y)`` with
+        ``h = residual + x`` and ``y = self(h)`` — one kernel pass on
+        TPU for last-dim norms (the post-norm transformer block's hot
+        chain), the bit-identical unfused composition otherwise."""
+        if len(self.normalized_shape) == 1:
+            return F.add_layer_norm(x, residual, self.weight, self.bias,
+                                    self.epsilon)
+        h = residual + x
+        return h, self.forward(h)
+
     def extra_repr(self):
         return f"{self.normalized_shape}, eps={self.epsilon}"
 
@@ -64,6 +75,13 @@ class RMSNorm(Layer):
 
     def forward(self, x):
         return F.rms_norm(x, self.weight, self.epsilon)
+
+    def forward_residual(self, x, residual):
+        """Fused residual→RMSNorm chain: returns ``(h, y)`` with
+        ``h = residual + x`` and ``y = self(h)`` — the Llama decoder's
+        post-attention chain as one kernel pass on TPU, bit-identical
+        composition elsewhere."""
+        return F.add_rms_norm(x, residual, self.weight, self.epsilon)
 
     def extra_repr(self):
         return f"{self.hidden_size}, eps={self.epsilon}"

@@ -120,16 +120,22 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
+        # post-norm blocks fuse the residual-add into the norm's pass
+        # (forward_residual; bit-identical to add-then-norm)
         if not self.normalize_before:
-            src = self.norm1(src)
+            src = self.norm1.forward_residual(self.dropout1(src),
+                                              residual)[1]
+        else:
+            src = residual + self.dropout1(src)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
         if not self.normalize_before:
-            src = self.norm2(src)
+            src = self.norm2.forward_residual(self.dropout2(src),
+                                              residual)[1]
+        else:
+            src = residual + self.dropout2(src)
         return src if cache is None else (src, cache)
 
     def gen_cache(self, src):
@@ -199,9 +205,12 @@ class TransformerDecoderLayer(Layer):
             tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
         else:
             tgt, new_inc = self.self_attn(tgt, tgt, tgt, tgt_mask, cache[0])
-        tgt = residual + self.dropout1(tgt)
+        # post-norm blocks: fused residual→norm chains (see encoder)
         if not self.normalize_before:
-            tgt = self.norm1(tgt)
+            tgt = self.norm1.forward_residual(self.dropout1(tgt),
+                                              residual)[1]
+        else:
+            tgt = residual + self.dropout1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -211,16 +220,20 @@ class TransformerDecoderLayer(Layer):
             tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
             if isinstance(tgt, tuple):
                 tgt = tgt[0]
-        tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
-            tgt = self.norm2(tgt)
+            tgt = self.norm2.forward_residual(self.dropout2(tgt),
+                                              residual)[1]
+        else:
+            tgt = residual + self.dropout2(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
-            tgt = self.norm3(tgt)
+            tgt = self.norm3.forward_residual(self.dropout3(tgt),
+                                              residual)[1]
+        else:
+            tgt = residual + self.dropout3(tgt)
         return tgt if cache is None else (tgt, (new_inc, cache[1]))
 
     def gen_cache(self, memory):

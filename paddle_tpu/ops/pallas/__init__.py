@@ -2,5 +2,6 @@
 
 Reference parity: paddle/phi/kernels/fusion/ + flash_attn_kernel
 (SURVEY.md §2.1) — here written as Mosaic/Pallas kernels tiled for the
-MXU instead of CUDA.
+MXU instead of CUDA.  fused_train.py holds the train-step regions
+(one-pass clip+optimizer update, add+norm, matmul+rotary).
 """

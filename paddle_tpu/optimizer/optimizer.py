@@ -151,6 +151,137 @@ class Optimizer:
                 {"slots": jax.tree_util.tree_unflatten(treedef, new_s),
                  "step": step})
 
+    # -- fused path (ops/pallas/fused_train) --------------------------------
+    _PACK_MAX_BYTES = 1 << 20   # leaves below this pack into flat buffers
+
+    def _fused_kind(self) -> Optional[str]:
+        """The fused-kernel family this optimizer's update() maps onto —
+        keyed on the update FUNCTION identity so a subclass overriding
+        the math silently falls back to the per-leaf loop instead of
+        running someone else's kernel."""
+        upd = type(self).update
+        if upd is SGD.update:
+            return "sgd"
+        if upd is Momentum.update:
+            return "momentum"
+        if upd in (Adam.update, AdamW.update):
+            return "adam"
+        return None
+
+    def _fused_hyper(self) -> Dict[str, Any]:
+        hp: Dict[str, Any] = {
+            "weight_decay": self._weight_decay,
+            "decoupled": self._decoupled_weight_decay(),
+        }
+        kind = self._fused_kind()
+        if kind == "momentum":
+            hp.update(momentum=self._momentum, nesterov=self._nesterov)
+        elif kind == "adam":
+            hp.update(beta1=self._beta1, beta2=self._beta2,
+                      epsilon=self._eps)
+        return hp
+
+    def apply_gradients_fused(self, params_tree, grads_tree, state, lr=None,
+                              pack_small: bool = True):
+        """Pure, jittable: one FUSED optimizer step over pytrees —
+        global-grad-norm → clip → update in one pass over each
+        (param, grad, slot) triple, with the clip scale, lr and
+        beta-correction folded into the update (weight decay stays
+        decoupled for AdamW).  Bit-identical to :meth:`apply_gradients`
+        by construction (the clip rounding is replayed in-register; see
+        ops/pallas/fused_train.py), same state-tree structure, so
+        checkpoints and ``state_dict`` round-trip across the two paths.
+
+        Dispatch: SGD / Momentum / Adam / AdamW with no clip or a
+        ``ClipGradByGlobalNorm`` use the fused kernel (jnp reference off
+        TPU); anything else falls back to the per-leaf reference loop.
+        With ``pack_small`` the long tail of sub-megabyte leaves (norm
+        scales, biases) is packed into ONE flat buffer per dtype pair —
+        one kernel launch / op chain for the whole tail — while large
+        leaves update in place with no packing copies.  Packing is for
+        the TPU kernel path (CompiledTrainStep auto-enables it there):
+        off it, packing reshapes XLA's fusion clusters, and CPU codegen
+        may contract FMAs differently at the last ulp — per-leaf mode
+        is what makes the fused program STRUCTURALLY identical to the
+        unfused one and therefore bitwise reproducible.  Sharded steps
+        always pass ``pack_small=False``: concatenating
+        differently-sharded leaves would force a GSPMD reshard."""
+        from ..nn.clip import ClipGradByGlobalNorm, global_norm_sq_f32
+        from ..ops.pallas import fused_train as FT
+        kind = self._fused_kind()
+        clip = self._grad_clip
+        if kind is None or (clip is not None
+                            and type(clip) is not ClipGradByGlobalNorm):
+            return self.apply_gradients(params_tree, grads_tree, state,
+                                        lr=lr)
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        step_f = jnp.asarray(step, jnp.float32)
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        if not flat_p:
+            return params_tree, {"slots": state["slots"], "step": step}
+        scale = None
+        if clip is not None:
+            gnorm = jnp.sqrt(global_norm_sq_f32(flat_g))
+            scale = jnp.minimum(1.0, clip.clip_norm
+                                / jnp.maximum(gnorm, 1e-12))
+        hyper = self._fused_hyper()
+        slot_keys = FT.SLOT_KEYS[kind]
+        new_p: List[Any] = [None] * len(flat_p)
+        new_s: List[Any] = [None] * len(flat_p)
+        groups: Dict[Tuple[str, str], List[int]] = {}
+        singles: List[int] = []
+        for i, (p, g) in enumerate(zip(flat_p, flat_g)):
+            if pack_small and p.size * p.dtype.itemsize \
+                    < self._PACK_MAX_BYTES:
+                groups.setdefault((p.dtype.name, g.dtype.name),
+                                  []).append(i)
+            else:
+                singles.append(i)
+        for idxs in list(groups.values()):
+            if len(idxs) == 1:      # a lone leaf gains nothing from a pack
+                singles.append(idxs[0])
+                idxs.clear()
+        for i in singles:
+            new_p[i], new_s[i] = FT.fused_update_flat(
+                kind, flat_p[i], flat_g[i], flat_s[i], lr=lr,
+                step_f=step_f, clip_scale=scale, hyper=hyper)
+        for idxs in groups.values():
+            if not idxs:
+                continue
+            pc = jnp.concatenate([flat_p[i].reshape(-1) for i in idxs])
+            gc = jnp.concatenate([flat_g[i].reshape(-1) for i in idxs])
+            sc = {k: jnp.concatenate([flat_s[i][k].reshape(-1)
+                                      for i in idxs]) for k in slot_keys}
+            npc, nsc = FT.fused_update_flat(
+                kind, pc, gc, sc, lr=lr, step_f=step_f, clip_scale=scale,
+                hyper=hyper)
+            off = 0
+            for i in idxs:
+                n = flat_p[i].size
+                shape = flat_p[i].shape
+                new_p[i] = npc[off:off + n].reshape(shape)
+                new_s[i] = {k: nsc[k][off:off + n].reshape(shape)
+                            for k in slot_keys}
+                off += n
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"slots": jax.tree_util.tree_unflatten(treedef, new_s),
+                 "step": step})
+
+    def update_flop_estimate(self, params_tree) -> float:
+        """Analytic FLOPs of one optimizer update (+ global-norm clip)
+        over the params tree.  CompiledTrainStep.step_flops adds this to
+        the MFU numerator when the update runs inside the Pallas fused
+        kernel — opaque to XLA's cost analysis — so pre/post-fusion MFU
+        numbers stay comparable."""
+        from ..ops.pallas import fused_train as FT
+        n = sum(int(p.size)
+                for p in jax.tree_util.tree_leaves(params_tree))
+        return FT.update_flop_estimate(self._fused_kind() or "adam", n,
+                                       self._grad_clip is not None)
+
     # -- state dict ----------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"@step": self._step_count}
