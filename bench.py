@@ -901,6 +901,97 @@ def bench_serving_metrics():
                       "budget": "overhead <= 2%"}}
 
 
+def bench_trace():
+    """Tracing-overhead row (ISSUE 9): decode tokens/sec through the
+    SAME scheduler-driven workload with the span tracer off vs on.
+    Tracing-off is a strict no-op (one module-global read returning
+    the NULL_SPAN singleton — the budget-guard test pins it), so the
+    interesting number is tracing ON: spans are recorded per request /
+    page chunk / decode WINDOW, never per token, and the acceptance
+    bar is <=3% throughput overhead.  Also reports the TTFT tail
+    (p50/p95) from the new histogram quantiles, and sanity-checks the
+    compile-count invariants with tracing enabled."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import tracing as obs_tracing
+    from paddle_tpu.serving import Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 256, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 4, 96, 8, 128, 4
+        prompts = [8, 5, 12, 9]
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if not on_tpu:
+        dtype = np.float32
+
+    def run(enable):
+        if enable:
+            obs_tracing.enable_tracing(max_spans=16384)
+        else:
+            obs_tracing.disable_tracing()
+        try:
+            rng = np.random.default_rng(0)
+            eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                            page_size=page, dtype=dtype,
+                            steps_per_sync=sync)
+            sched = Scheduler(eng)
+            for i, plen in enumerate(prompts):
+                sched.submit(
+                    f"t{i}",
+                    rng.integers(1, cfg.vocab_size, plen).tolist(),
+                    max_new_tokens=new)
+            sched.step()               # warmup: compiles the window
+            produced0 = sum(len(r.out)
+                            for r in eng.requests.values())
+            t0 = time.perf_counter()
+            sched.run_until_idle()
+            dt = time.perf_counter() - t0
+            total = sum(
+                len(sched.result(f"t{i}"))
+                for i in range(len(prompts))) - produced0
+            return total / dt, eng
+        finally:
+            obs_tracing.disable_tracing()
+
+    run(False)                         # shared compile + cache warmup
+    off, on = [], []
+    eng_on = None
+    for _ in range(5):                 # interleaved best-of (clock
+        off.append(run(False)[0])      # drift hits both arms equally)
+        rate, eng_on = run(True)
+        on.append(rate)
+    best_off, best_on = max(off), max(on)
+    overhead = (best_off - best_on) / best_off
+    snap = eng_on.metrics_snapshot()
+    return {"metric": "llama_serving_tracing_overhead_pct",
+            "unit": "percent", "value": round(overhead * 100, 2),
+            "extra": {"device_kind": kind,
+                      "tokens_per_sec_tracing_off": round(best_off, 1),
+                      "tokens_per_sec_tracing_on": round(best_on, 1),
+                      "ttft_p50_ms": round(
+                          snap["ttft_seconds"]["p50"] * 1e3, 2),
+                      "ttft_p95_ms": round(
+                          snap["ttft_seconds"]["p95"] * 1e3, 2),
+                      "tpot_p95_us": round(
+                          snap["tpot_seconds"]["p95"] * 1e6, 1),
+                      "prefill_compiles": snap["prefill_compiles"],
+                      "decode_compiles": snap["decode_compiles"],
+                      "budget": "overhead <= 3%"}}
+
+
 def bench_serving_prefix():
     """Automatic-prefix-caching row (ISSUE 3): N requests sharing a
     long system prompt, admitted through the SAME engine workload with
@@ -1575,6 +1666,7 @@ def main():
                ("bench_engine", bench_engine),
                ("bench_serving_quant", bench_serving_quant),
                ("bench_serving_metrics", bench_serving_metrics),
+               ("bench_trace", bench_trace),
                ("bench_serving_prefix", bench_serving_prefix),
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),

@@ -108,9 +108,16 @@ class CheckpointManager:
         returns (training may mutate/donate immediately) and disk writes
         happen on a bounded background queue.  Raises a previous
         background save's failure before starting a new one."""
-        with self._lock:
+        from ..observability import tracing as _tracing
+        with self._lock, _tracing.span(
+                "train.checkpoint_save",
+                attrs={"step": step,
+                       "mode": "async" if self.async_save
+                       else "sync"}):
             # bounded queue: block on the oldest writer for a free slot,
-            # surfacing its failure here if it had one
+            # surfacing its failure here if it had one.  The span
+            # covers the host-side snapshot (async mode) or the whole
+            # committed write (sync) — what the training loop WAITS on
             self._drain_locked(want_free_slot=True)
             path = self.step_dir(step)
             if hasattr(state, "save_checkpoint"):
@@ -218,6 +225,18 @@ class CheckpointManager:
 
         def handler(signum, frame):
             self.preempted = True
+            # flight recorder (when one is enabled): the preemption
+            # moment and what the process was doing land on disk even
+            # if the post-save shutdown never completes.  Flag flip
+            # stays first — a failing dump cannot lose the preemption.
+            from ..observability import tracing as _tracing
+            rec = _tracing.get_flight_recorder()
+            if rec is not None:
+                rec.record("preempted", signum=int(signum))
+                try:
+                    rec.dump(reason="preempted")
+                except Exception:
+                    pass
             if self._on_preempt is not None:
                 self._on_preempt()
             if callable(prev) and prev not in (

@@ -151,13 +151,19 @@ class ShardedTrainStep(CompiledTrainStep):
         self._key, sub = jax.random.split(self._key)
         lr = self.optimizer.get_lr()
         batch = self.plan.shard_batch(_to_arrays(batch))
-        # same StepTimer contract as the parent: fence on the sharded
-        # outputs so multi-chip async dispatch can't flatter step time
+        # same tracing + StepTimer contract as the parent: one span
+        # per step, fence on the sharded outputs so multi-chip async
+        # dispatch can't flatter step time
+        from ..observability import tracing as _tracing
+        span = _tracing.span("train.compiled_step")
+        span.set_attr("step", self._step_count)
+        span.set_attr("sharded", True)
         if self._timer is not None:
             self._timer.start()
         self.state, loss = self._step_fn(self.state, batch, sub, lr)
         if self._timer is not None:
             self._timer.stop(fence=(self.state, loss))
+        span.end()
         # same resumable-state contract as the parent: the update count
         # must tick here too or a sharded run's checkpoint lies about
         # its position

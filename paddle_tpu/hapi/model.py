@@ -303,11 +303,26 @@ class Model:
         # the VisualDL callback's writer when one is configured
         from .callbacks import VisualDL
         from ..observability import StepTimer
+        from ..observability import tracing as _tracing
         vdl = next((c for c in cbks.callbacks
                     if isinstance(c, VisualDL)), None)
         timer = StepTimer(prefix="train",
                           writer=vdl._w() if vdl is not None else None)
         step.attach_timer(timer)
+
+        def traced_batches(ldr):
+            # one "train.data_load" span per batch FETCH (host input
+            # pipeline time, distinct from the compiled-step span the
+            # train step emits) — the NULL_SPAN singleton when tracing
+            # is off, so the loop shape costs nothing
+            it = iter(ldr)
+            while True:
+                with _tracing.span("train.data_load"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                yield batch
         self.stop_training = False
         cbks.on_train_begin()
         logs = {}
@@ -320,7 +335,7 @@ class Model:
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            for step_i, batch in enumerate(loader):
+            for step_i, batch in enumerate(traced_batches(loader)):
                 cbks.on_train_batch_begin(step_i)
                 ins, labs = self._split_batch(batch)
                 if ins:

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..common.errors import enforce
 from ..observability import get_registry
+from ..observability import tracing as _tracing
 from ..profiler import RecordEvent
 from .paged_cache import PagedKVCache
 
@@ -634,6 +635,11 @@ class LLMEngine:
             chunk = np.zeros(P, np.int32)
             real = min(P, plen - base)
             chunk[:real] = np.asarray(seq[base:base + real], np.int32)
+            # per-chunk span (nests under the active admit/prefill
+            # span); one object per PAGE of prompt, never per token —
+            # and the shared NULL_SPAN when tracing is off
+            chunk_span = _tracing.span("engine.prefill_chunk")
+            chunk_span.set_attr("chunk", ci).set_attr("tokens", real)
             (logits, self.cache.k_pages, self.cache.v_pages,
              self.cache.k_scales, self.cache.v_scales) = \
                 _paged_prefill_chunk(
@@ -648,6 +654,7 @@ class LLMEngine:
                     eps=self.eps, kvh=self.kvh,
                     head_dim=self.head_dim,
                     transpose_head=self._tied)
+            chunk_span.end()
         return logits
 
     def _replay_decode(self, slot, toks):
@@ -951,7 +958,10 @@ class LLMEngine:
         enforce(not req.done, f"request {rid!r} already retired")
         enforce(not req.suspended, f"request {rid!r} already suspended")
         self._active.remove(req)
-        req.swap_handle = self.cache.swap_out(req.slot)
+        with _tracing.span("engine.swap_out") as sp:
+            req.swap_handle = self.cache.swap_out(req.slot)
+            sp.set_attr("rid", str(rid))
+            sp.set_attr("armed", req.swap_handle is not None)
         req.slot = None
         req.suspended = True
         if self._metrics is not None:
@@ -980,7 +990,9 @@ class LLMEngine:
         total = plen + req.max_new
         path = None
         if req.swap_handle is not None:
-            slot = self.cache.swap_in(req.swap_handle, total)
+            with _tracing.span("engine.swap_in") as sp:
+                sp.set_attr("rid", str(rid))
+                slot = self.cache.swap_in(req.swap_handle, total)
             req.swap_handle = None             # consumed either way
             if slot is not None:
                 # KV restored byte-exact; length = prompt + generated

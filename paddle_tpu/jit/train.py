@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..autograd import tape
 from ..nn.layer import Layer, functional_state
+from ..observability import tracing as _tracing
 from ..ops import random as _random
 from ..optimizer.optimizer import Optimizer
 from ..tensor import Tensor
@@ -225,12 +226,18 @@ class CompiledTrainStep:
             self._build()
         self._key, sub = jax.random.split(self._key)
         lr = self.optimizer.get_lr()
+        # one span per optimizer step (covers dispatch + the timer's
+        # block_until_ready fence when attached, so the span extent is
+        # device-inclusive); the shared NULL_SPAN when tracing is off
+        span = _tracing.span("train.compiled_step")
+        span.set_attr("step", self._step_count)
         if self._timer is not None:
             self._timer.start()
         self.state, out = self._step_fn(self.state, _to_arrays(batch), sub,
                                         lr)
         if self._timer is not None:
             self._timer.stop(fence=(self.state, out))
+        span.end()
         self._step_count += 1
         sched = self.optimizer._lr_scheduler
         if sched is not None:

@@ -11,6 +11,12 @@ Three pieces, all stdlib-only:
   fenced per-step wall time, tokens/s, and cost_analysis-based MFU
   for the training loop (wired through ``hapi.Model.fit`` and
   ``jit.train.CompiledTrainStep.attach_timer``).
+- :mod:`~paddle_tpu.observability.tracing` — request/step span
+  tracing (``Tracer``, trace-context HTTP propagation, Chrome-trace
+  export) and the crash ``FlightRecorder`` (bounded event+span ring
+  dumped to JSONL on SIGTERM / fatal / wedge).  Disabled tracing is a
+  strict hot-path no-op: instrumentation sites read one module global
+  and get the shared ``NULL_SPAN`` singleton back.
 
 Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
 gauges, compile-count gauges) lives with the instrumented code in
@@ -28,8 +34,14 @@ from .metrics import (Counter, Gauge, Histogram, MetricRegistry,
 from .exposition import (JsonlSnapshotWriter, MetricsServer,
                          start_metrics_server)
 from .steptimer import StepTimer, device_peak_flops
+from .tracing import (FlightRecorder, Span, Tracer, disable_tracing,
+                      enable_flight_recorder, enable_tracing,
+                      get_flight_recorder, get_tracer)
+from . import tracing
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
            "DEFAULT_BUCKETS", "get_registry", "JsonlSnapshotWriter",
            "MetricsServer", "start_metrics_server", "StepTimer",
-           "device_peak_flops"]
+           "device_peak_flops", "Span", "Tracer", "FlightRecorder",
+           "enable_tracing", "disable_tracing", "get_tracer",
+           "enable_flight_recorder", "get_flight_recorder", "tracing"]

@@ -242,6 +242,30 @@ class Histogram(_Metric):
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0..1) by linear interpolation inside
+        the bucket holding the q-th observation (Prometheus
+        ``histogram_quantile`` semantics).  Observations past the last
+        finite bucket clamp to that bound — a fixed-bucket histogram
+        cannot resolve its own overflow tail.  0.0 when empty."""
+        enforce(0.0 <= q <= 1.0, f"quantile {q} outside [0, 1]")
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c:
+                if i >= len(self.buckets):      # +Inf overflow bucket
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (rank - (cum - c)) / c
+        return self.buckets[-1]
+
     def _snapshot_value(self):
         cum = 0
         buckets = {}
@@ -249,10 +273,16 @@ class Histogram(_Metric):
             cum += c
             buckets[_fmt_value(ub)] = cum
         return {"count": self._count, "sum": self._sum,
-                "mean": self.mean, "buckets": buckets}
+                "mean": self.mean, "buckets": buckets,
+                # bucket-interpolated latency percentiles, so /statusz
+                # and bench rows report tails instead of mean-only
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
 
     def snapshot(self) -> dict:
-        """{count, sum, mean, buckets{le: cumulative}} for this child."""
+        """{count, sum, mean, p50/p95/p99, buckets{le: cumulative}}
+        for this child (quantiles are bucket-interpolated
+        estimates)."""
         return self._snapshot_value()
 
     def _sample_lines(self, parent, lv):

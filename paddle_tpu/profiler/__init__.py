@@ -21,6 +21,8 @@ from collections import deque
 from enum import Enum
 from typing import Callable, Iterable, Optional
 
+from ..observability import tracing as _tracing
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
 
@@ -87,6 +89,15 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
             {"name": name, "ph": "X", "pid": 0, "tid": 1,
              "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
             for name, t0, t1 in list(_HOST_EVENTS) if t0 >= begin)
+        # the observability tracer's spans (request spans, scheduler
+        # queue waits, engine chunk/window spans) land on their own
+        # track — the profiler session and the serving tracer share
+        # one timeline, which is what makes the Paddle-shaped
+        # profiler API a real end-to-end export
+        tracer = _tracing.get_tracer()
+        if tracer is not None:
+            events.extend(e for e in tracer.chrome_events(tid=2)
+                          if e["ts"] >= int(begin * 1e6))
         with open(os.path.join(dir_name, "steps.chrome_trace.json"),
                   "w") as f:
             json.dump({"traceEvents": events}, f)
@@ -99,20 +110,30 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
 
 class RecordEvent:
     """Host range annotation visible in the device trace
-    (reference: paddle.profiler.RecordEvent over C++ RecordEvent)."""
+    (reference: paddle.profiler.RecordEvent over C++ RecordEvent).
+    When the observability tracer is enabled, the range ALSO records
+    as a span there — nesting under whatever span is active on this
+    thread (e.g. the scheduler's admit span), so profiler-annotated
+    engine work lands inside the request's trace."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
         self._t0 = None
+        self._span = None
 
     def begin(self):
         import jax
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        sp = _tracing.span(self.name)
+        self._span = sp if sp is not _tracing.NULL_SPAN else None
         self._t0 = time.perf_counter()
 
     def end(self):
+        if self._span is not None:
+            self._span.end()
+            self._span = None
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None

@@ -56,6 +56,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import tracing as _tracing
+from ..observability.tracing import record_event
 from .scheduler import RejectedError
 
 __all__ = ["ReplicaRouter"]
@@ -257,6 +259,20 @@ class ReplicaRouter:
             if kw.get("on_event") is not None:
                 tap = _EventTap(kw["on_event"])
                 kw["on_event"] = tap
+            # pin the trace context at THIS level when the caller did
+            # not: the remembered kw is what ejection-requeue and
+            # failover retries resubmit, so the request keeps ONE
+            # trace across replicas instead of each replica minting a
+            # fresh root
+            if kw.get("trace_ctx") is None:
+                tr = _tracing.get_tracer()
+                if tr is not None and tr.enabled:
+                    root = tr.start_span(
+                        "router.request", activate=False,
+                        attrs={"rid": str(rid),
+                               "router": self.router_id})
+                    root.end()
+                    kw["trace_ctx"] = root.context()
             prompt = list(prompt_ids)
             idx = self._route(rid, prompt, kw)
             self._requests[rid] = (prompt, kw, tap)
@@ -344,6 +360,33 @@ class ReplicaRouter:
         with self._lock:
             return rid in self._owner
 
+    def request_timeline(self, rid) -> dict:
+        """The owning replica's per-request timing breakdown
+        (``Scheduler.request_timeline``).  A request that failed over
+        answers from its CURRENT owner — the trace id ties the hops
+        together."""
+        with self._lock:
+            return self.replicas[self._replica_of(rid)] \
+                .request_timeline(rid)
+
+    def requests_overview(self) -> List[dict]:
+        """Live requests across every non-ejected replica (the
+        ``/statusz`` request table); an unreachable replica
+        contributes an error marker instead of failing the scrape."""
+        out: List[dict] = []
+        with self._lock:
+            for i, replica in enumerate(self.replicas):
+                if i in self._ejected:
+                    continue
+                try:
+                    rows = replica.requests_overview()
+                except Exception as e:
+                    rows = [{"replica": i, "error": str(e)}]
+                else:
+                    rows = [dict(r, replica=i) for r in rows]
+                out.extend(rows)
+        return out
+
     def snapshot_requests(self, rids) -> Dict[object, dict]:
         """Poll view over all replicas (the remote-transport surface,
         delegated to each rid's owner)."""
@@ -416,6 +459,8 @@ class ReplicaRouter:
             if idx in self._ejected:
                 return []
             self._ejected.add(idx)
+            record_event("replica_ejected", router=self.router_id,
+                         replica=idx)
             if self._metrics is not None:
                 self._m_ejected.inc()
             self._track_replica(idx)

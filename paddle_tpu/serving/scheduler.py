@@ -69,6 +69,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import tracing as _tracing
 
 __all__ = ["Scheduler", "RejectedError", "ScheduledRequest"]
 
@@ -129,6 +130,15 @@ class ScheduledRequest:
         self.preempt_t: Optional[float] = None
         self.overtaken = 0
         self.in_heap = False
+        # observability: the request's trace context ({"trace_id",
+        # "parent_id"} — propagated from the frontend or minted here),
+        # held-open spans by role (root/queue/suspend), the structured
+        # timeline (event name, clock) request_timeline() serves, and
+        # when the first token landed (scheduler-side TTFT)
+        self.trace_ctx: Optional[dict] = None
+        self.spans: Dict[str, object] = {}
+        self.timeline: List[tuple] = []
+        self.first_token_t: Optional[float] = None
 
     def __lt__(self, other):                # heapq tie-breaks via seq
         return (self.priority, self.seq) < (other.priority, other.seq)
@@ -264,7 +274,8 @@ class Scheduler:
                eos_token_id: Optional[int] = None, priority: int = 0,
                deadline: Optional[float] = None,
                max_queue_time: Optional[float] = None,
-               on_event: Optional[Callable[[dict], None]] = None):
+               on_event: Optional[Callable[[dict], None]] = None,
+               trace_ctx: Optional[dict] = None):
         """Queue a request.  Raises ``RejectedError`` when the bounded
         queue is full or the scheduler is draining, and
         ``InvalidArgumentError`` for requests that could NEVER be
@@ -274,7 +285,12 @@ class Scheduler:
         ``deadline`` / ``max_queue_time`` are seconds from submission;
         ``on_event`` receives ``{"type": "tokens"|"finished"|
         "cancelled"|"shed", "rid": ..., ...}`` dicts as the request
-        progresses (tokens stream per engine step window)."""
+        progresses (tokens stream per engine step window).
+        ``trace_ctx`` is the propagated trace context (``{"trace_id",
+        "parent_id"}`` — from the HTTP frontend's root span, or a
+        remote submit's headers); with tracing enabled and no context,
+        the scheduler roots a trace itself, so a directly-driven
+        scheduler still yields connected traces."""
         eng = self.engine
         plen = len(list(prompt_ids))
         enforce(plen >= 1, "empty prompt")
@@ -315,6 +331,8 @@ class Scheduler:
             heapq.heappush(self._heap, rec)
             rec.in_heap = True
             self._n_waiting += 1
+            rec.timeline.append(("submitted", now))
+            self._trace_enqueue(rec, trace_ctx)
             self._set_waiting_gauge()
         return rid
 
@@ -332,6 +350,7 @@ class Scheduler:
                 rec.state = CANCELLED
                 rec.finish_t = self._clock()
                 self._n_waiting -= 1
+                self._trace_terminal(rec, CANCELLED)
                 if self._metrics is not None:
                     self._metrics["aborts"].inc()
                 self._set_waiting_gauge()
@@ -460,6 +479,50 @@ class Scheduler:
                                 "shed_reason": rec.shed_reason}
         return out
 
+    # -- per-request timing breakdown ------------------------------------------
+    def request_timeline(self, rid) -> dict:
+        """Structured life-of-a-request record: submitted / admitted /
+        first-token / preemption-resume / migration / terminal
+        timestamps (this scheduler's clock), derived queue-wait and
+        TTFT, and the trace id tying it to the span tracer.  Readable
+        in ANY state — a live request answers with what has happened
+        so far.  Unknown rids raise (like ``status``)."""
+        with self._lock:
+            enforce(rid in self._reqs, f"unknown request id {rid!r}")
+            rec = self._reqs[rid]
+            return {
+                "rid": str(rec.rid), "sched": self.sched_id,
+                "state": rec.state, "priority": rec.priority,
+                "trace_id": (rec.trace_ctx or {}).get("trace_id"),
+                "submitted": rec.submit_t, "admitted": rec.admit_t,
+                "first_token": rec.first_token_t,
+                "finished": rec.finish_t,
+                "queue_wait": None if rec.admit_t is None
+                else rec.admit_t - rec.submit_t,
+                "ttft": None if rec.first_token_t is None
+                else rec.first_token_t - rec.submit_t,
+                "preemptions": rec.preempts,
+                "n_tokens": len(rec.tokens),
+                "deadline_missed": rec.deadline_missed,
+                "shed_reason": rec.shed_reason,
+                "timeline": [{"event": e, "t": t}
+                             for e, t in rec.timeline],
+            }
+
+    def requests_overview(self) -> List[dict]:
+        """Live (waiting/active/suspended) requests with ages — the
+        ``/statusz`` request table."""
+        now = self._clock()
+        with self._lock:
+            return [{"rid": str(rec.rid), "sched": self.sched_id,
+                     "state": rec.state, "priority": rec.priority,
+                     "age": now - rec.submit_t,
+                     "n_tokens": len(rec.tokens),
+                     "preemptions": rec.preempts,
+                     "trace_id": (rec.trace_ctx or {}).get("trace_id")}
+                    for rec in self._reqs.values()
+                    if rec.state in (WAITING, ACTIVE, SUSPENDED)]
+
     # -- migration (KV-migrating drain / rebalance) ----------------------------
     def migrate_out(self, rid) -> Optional[dict]:
         """Export one live request as a migration package for another
@@ -490,6 +553,7 @@ class Scheduler:
                        "deadline_remaining":
                            None if rec.deadline is None
                            else rec.deadline - now,
+                       "trace": rec.trace_ctx,
                        "on_event": rec.on_event}
                 if rec.state == WAITING:
                     pkg.update({
@@ -502,11 +566,17 @@ class Scheduler:
                             - (now - rec.submit_t)})
                     self._n_waiting -= 1
                 else:
-                    if rec.state == ACTIVE:
-                        self.engine.suspend(rid)
-                    else:
-                        self._n_suspended -= 1
-                    epkg = self.engine.export_request(rid)
+                    with _tracing.span("sched.migrate_out",
+                                       ctx=rec.trace_ctx) as sp:
+                        if rec.state == ACTIVE:
+                            self.engine.suspend(rid)
+                        else:
+                            self._n_suspended -= 1
+                        epkg = self.engine.export_request(rid)
+                        sp.set_attr("rid", str(rid))
+                        sp.set_attr("sched", self.sched_id)
+                        sp.set_attr("swap",
+                                    epkg["swap"] is not None)
                     pkg.update({
                         "admitted": True, "prompt": epkg["prompt"],
                         "tokens": epkg["out"],
@@ -514,6 +584,7 @@ class Scheduler:
                         "swap": epkg["swap"],
                         "max_queue_time_remaining": None})
                 rec.state = MIGRATED
+                self._trace_terminal(rec, MIGRATED)
                 del self._reqs[rid]
                 if self._metrics is not None:
                     self._metrics["migrated_out"].inc()
@@ -567,6 +638,12 @@ class Scheduler:
                         f"waiting queue full ({self.max_queue}); "
                         f"migrated request {rid!r} shed")
                 self._n_waiting += 1
+            rec.timeline.append(("migrated_in", now))
+            # continue the SOURCE's trace (the package carries its
+            # context), so a migrated request stays ONE trace across
+            # hosts; admitted packages re-enter as suspended
+            self._trace_enqueue(rec, pkg.get("trace"),
+                                suspended=bool(pkg["admitted"]))
             self._reqs[rid] = rec
             heapq.heappush(self._heap, rec)
             rec.in_heap = True
@@ -673,6 +750,50 @@ class Scheduler:
         for cb, ev in events:
             cb(ev)
 
+    # -- tracing internals (lock held; strict no-ops with tracing off) ---------
+    def _trace_enqueue(self, rec, trace_ctx, suspended: bool = False):
+        """Adopt (or mint) the request's trace context and open the
+        held span covering its time in the queue — ``sched.queue_wait``
+        for fresh submissions, ``sched.suspended`` for migrated-in
+        admitted requests."""
+        tr = _tracing.get_tracer()
+        if tr is None or not tr.enabled:
+            rec.trace_ctx = trace_ctx
+            return
+        if trace_ctx is None:
+            root = tr.start_span(
+                "sched.request", activate=False,
+                attrs={"rid": str(rec.rid), "sched": self.sched_id})
+            rec.spans["root"] = root
+            trace_ctx = root.context()
+        rec.trace_ctx = trace_ctx
+        key, name = ("suspend", "sched.suspended") if suspended \
+            else ("queue", "sched.queue_wait")
+        rec.spans[key] = tr.start_span(
+            name, ctx=trace_ctx, activate=False,
+            attrs={"rid": str(rec.rid), "sched": self.sched_id})
+
+    @staticmethod
+    def _end_span(rec, key) -> None:
+        sp = rec.spans.pop(key, None)
+        if sp is not None:
+            sp.end()
+
+    def _trace_terminal(self, rec, state, reason=None) -> None:
+        """Close every held span at a terminal transition (finished /
+        cancelled / shed / migrated) and stamp the timeline."""
+        rec.timeline.append((state, rec.finish_t
+                             if rec.finish_t is not None
+                             else self._clock()))
+        self._end_span(rec, "queue")
+        self._end_span(rec, "suspend")
+        root = rec.spans.pop("root", None)
+        if root is not None:
+            root.set_attr("state", state)
+            if reason is not None:
+                root.set_attr("reason", reason)
+            root.end()
+
     def _process_aborts(self, events):
         for rid in self._pending_abort:
             rec = self._reqs.get(rid)
@@ -684,6 +805,7 @@ class Scheduler:
                 rec.tokens = self.engine.pop_result(rid)
                 rec.state = CANCELLED
                 rec.finish_t = self._clock()
+                self._trace_terminal(rec, CANCELLED)
                 if self._metrics is not None:
                     self._metrics["aborts"].inc()
                 self._set_waiting_gauge()
@@ -714,6 +836,7 @@ class Scheduler:
             rec.shed_reason = reason
             rec.finish_t = now
             self._n_waiting -= 1
+            self._trace_terminal(rec, SHED, reason=reason)
             self._shed_inc(reason)
             self._event(events, rec, {"type": "shed", "rid": rec.rid,
                                       "reason": reason})
@@ -761,7 +884,13 @@ class Scheduler:
         eng = self.engine
         now = self._clock()
         if rec.state == SUSPENDED:
-            eng.resume(rec.rid)
+            self._end_span(rec, "suspend")
+            with _tracing.span("sched.resume", ctx=rec.trace_ctx) as sp:
+                path = eng.resume(rec.rid)
+                sp.set_attr("rid", str(rec.rid))
+                sp.set_attr("sched", self.sched_id)
+                sp.set_attr("path", path)
+            rec.timeline.append((f"resumed:{path}", now))
             rec.state = ACTIVE
             self._n_suspended -= 1
             if self._metrics is not None and rec.preempt_t is not None:
@@ -769,11 +898,22 @@ class Scheduler:
                     now - rec.preempt_t)
             rec.preempt_t = None
             return
-        eng.add_request(rec.rid, rec.prompt,
-                        max_new_tokens=rec.max_new,
-                        eos_token_id=rec.eos)
+        self._end_span(rec, "queue")
+        # the admit span is ACTIVATED: the engine's prefill spans
+        # (whole-prompt + per-chunk) nest under it, landing the whole
+        # admission inside the request's trace
+        with _tracing.span("sched.admit", ctx=rec.trace_ctx) as sp:
+            eng.add_request(rec.rid, rec.prompt,
+                            max_new_tokens=rec.max_new,
+                            eos_token_id=rec.eos)
+            sp.set_attr("rid", str(rec.rid))
+            sp.set_attr("sched", self.sched_id)
+            sp.set_attr("prompt_tokens", len(rec.prompt))
         rec.state = ACTIVE
         rec.admit_t = now
+        rec.first_token_t = self._clock()   # admission's prefill token
+        rec.timeline.append(("admitted", now))
+        rec.timeline.append(("first_token", rec.first_token_t))
         self._n_waiting -= 1
         if self._metrics is not None:
             self._metrics["queue_wait"].observe(now - rec.submit_t)
@@ -800,10 +940,19 @@ class Scheduler:
         if not cands:
             return False
         victim = max(cands, key=lambda r: (r.priority, r.seq))
-        self.engine.suspend(victim.rid)
+        with _tracing.span("sched.preempt", ctx=victim.trace_ctx) as sp:
+            self.engine.suspend(victim.rid)
+            sp.set_attr("rid", str(victim.rid))
+            sp.set_attr("sched", self.sched_id)
         victim.state = SUSPENDED
         victim.preempts += 1
         victim.preempt_t = self._clock()
+        victim.timeline.append(("preempted", victim.preempt_t))
+        tr = _tracing.get_tracer()
+        if tr is not None and tr.enabled:
+            victim.spans["suspend"] = tr.start_span(
+                "sched.suspended", ctx=victim.trace_ctx, activate=False,
+                attrs={"rid": str(victim.rid), "sched": self.sched_id})
         self._n_suspended += 1
         if not victim.in_heap:
             heapq.heappush(self._heap, victim)
@@ -850,6 +999,7 @@ class Scheduler:
             rec.tokens = self.engine.pop_result(rid)
             rec.state = FINISHED
             rec.finish_t = self._clock()
+            self._trace_terminal(rec, FINISHED)
             if rec.deadline is not None and rec.finish_t > rec.deadline:
                 rec.deadline_missed = True
                 if self._metrics is not None:

@@ -59,21 +59,27 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
+import platform
 import queue
+import sys
 import threading
 import time
+import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..common.errors import EnforceError, UnavailableError
 from ..observability import get_registry
+from ..observability import tracing as _tracing
 from ..observability.exposition import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from .scheduler import RejectedError
 
 __all__ = ["HTTPFrontend", "start_http_frontend"]
 
 _TERMINAL = ("finished", "cancelled", "shed")
+_LOG = logging.getLogger("paddle_tpu.serving")
 
 
 class HTTPFrontend:
@@ -89,13 +95,19 @@ class HTTPFrontend:
                  registry=None, default_max_tokens: int = 64,
                  request_timeout: float = 120.0,
                  poll_interval: float = 0.002,
-                 max_body_bytes: int = 4 << 20):
+                 max_body_bytes: int = 4 << 20,
+                 slow_ttft: Optional[float] = 1.0):
         self.target = target
         self.registry = registry or get_registry()
         self.default_max_tokens = default_max_tokens
         self.request_timeout = request_timeout
         self.poll_interval = poll_interval
         self.max_body_bytes = int(max_body_bytes)
+        # TTFT threshold (seconds) past which one slow-request line —
+        # rid, trace_id, queue wait, preemptions — is logged; None
+        # disables
+        self.slow_ttft = slow_ttft
+        self._t_start = time.monotonic()
         self._stop = threading.Event()
         self._cmds: "queue.Queue[tuple]" = queue.Queue()
         frontend = self
@@ -139,7 +151,7 @@ class HTTPFrontend:
                     return None
 
             def do_GET(self):
-                path = self.path.split("?")[0]
+                path, _, query = self.path.partition("?")
                 if path == "/healthz":
                     code, body = frontend._health()
                     self._json(code, body)
@@ -152,9 +164,18 @@ class HTTPFrontend:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/statusz":
+                    frontend._guarded(self, frontend._statusz)
+                elif path == "/tracez":
+                    frontend._guarded(
+                        self, lambda: frontend._tracez(query))
                 elif path == "/v1/load":
                     frontend._guarded(self, lambda: {
                         "load": frontend.target.load()})
+                elif path == "/v1/requests":
+                    frontend._guarded(self, lambda: {
+                        "requests":
+                            frontend.target.requests_overview()})
                 elif path == "/v1/stats":
                     frontend._guarded(
                         self, frontend.target.metrics_snapshot)
@@ -172,6 +193,7 @@ class HTTPFrontend:
                     "/v1/pop_result": frontend._cp_pop_result,
                     "/v1/forget": frontend._cp_forget,
                     "/v1/drain": frontend._cp_drain,
+                    "/v1/timeline": frontend._cp_timeline,
                     "/v1/migrate_out": frontend._cp_migrate_out,
                     "/v1/migrate_in": frontend._cp_migrate_in,
                 }
@@ -274,6 +296,7 @@ class HTTPFrontend:
         stopped, 503 ``wedged`` when the scheduling loop thread died
         (alive socket, dead engine: the worst failure to hide)."""
         if not self._loop_thread.is_alive() and not self._stop.is_set():
+            self._wedge_dump("loop thread died")
             return 503, {"status": "wedged",
                          "reason": "scheduler loop thread died — "
                                    "accepting connections but not "
@@ -281,6 +304,7 @@ class HTTPFrontend:
         try:
             snap = self.target.metrics_snapshot()
         except Exception as e:
+            self._wedge_dump(f"target snapshot failed: {e}")
             return 503, {"status": "wedged",
                          "reason": f"target snapshot failed: {e}"}
         out = {"status": "ok"}
@@ -301,6 +325,94 @@ class HTTPFrontend:
                                    "is refused"}
         return 200, out
 
+    def _wedge_dump(self, reason: str):
+        """Wedge detected: record it and dump the flight record ONCE
+        (health probes repeat; the record must not be rewritten on
+        every probe)."""
+        rec = _tracing.get_flight_recorder()
+        if rec is not None:
+            rec.record("wedge", reason=reason, port=self.port)
+            try:
+                rec.dump_once("wedged")
+            except Exception:
+                pass                      # a failing dump can't take
+                                          # the health endpoint down
+
+    # -- handlers: statusz / tracez --------------------------------------------
+    def _statusz(self) -> dict:
+        """Operator summary: build/config, the live request table with
+        ages, cache occupancy, latency percentiles, recent errors —
+        the one page to read FIRST when a host misbehaves."""
+        try:
+            import jax
+            jax_ver = jax.__version__
+        except Exception:
+            jax_ver = None
+        out = {
+            "status": self._health()[1].get("status", "ok"),
+            "uptime_seconds": time.monotonic() - self._t_start,
+            "build": {"python": sys.version.split()[0],
+                      "jax": jax_ver,
+                      "platform": platform.platform()},
+            "config": {"addr": self.addr, "port": self.port,
+                       "default_max_tokens": self.default_max_tokens,
+                       "request_timeout": self.request_timeout,
+                       "slow_ttft": self.slow_ttft},
+        }
+        try:
+            out["requests"] = self.target.requests_overview()
+        except Exception as e:
+            out["requests"] = [{"error": str(e)}]
+        try:
+            snap = self.target.metrics_snapshot()
+        except Exception as e:
+            snap = {"error": str(e)}
+        # surface the capacity/latency headline (router targets nest
+        # per-replica; scheduler targets answer directly)
+        eng = snap.get("engine") or {}
+        out["target"] = {
+            "waiting": snap.get("waiting"),
+            "suspended": snap.get("suspended"),
+            "draining": snap.get("draining"),
+            "shed": snap.get("shed"),
+            "replicas": len(snap["replicas"])
+            if "replicas" in snap else None,
+            "kv_page_utilization": eng.get("kv_page_utilization"),
+            "active_requests": eng.get("active_requests"),
+            "ttft_seconds": {
+                k: eng["ttft_seconds"][k]
+                for k in ("count", "mean", "p50", "p95", "p99")
+                if k in eng.get("ttft_seconds", {})}
+            if isinstance(eng.get("ttft_seconds"), dict) else None,
+        }
+        tr = _tracing.get_tracer()
+        out["tracing"] = {"enabled": tr is not None and tr.enabled,
+                          "finished_spans": len(tr.finished_spans())
+                          if tr is not None else 0,
+                          "dropped_spans": tr.dropped
+                          if tr is not None else 0}
+        rec = _tracing.get_flight_recorder()
+        out["recent_errors"] = rec.recent_errors() \
+            if rec is not None else []
+        return out
+
+    def _tracez(self, query: str) -> dict:
+        """Recent slow traces: every trace whose wall extent exceeds
+        ``threshold_ms`` (query param, default 100), slowest first,
+        ``limit`` traces (default 20) with their full span trees."""
+        qs = urllib.parse.parse_qs(query or "")
+        thr_ms = float(qs.get("threshold_ms", ["100"])[0])
+        limit = int(qs.get("limit", ["20"])[0])
+        tr = _tracing.get_tracer()
+        if tr is None or not tr.enabled:
+            return {"enabled": False, "threshold_ms": thr_ms,
+                    "traces": []}
+        traces = tr.slow_traces(thr_ms / 1e3, limit=limit)
+        for t in traces:
+            t["duration_ms"] = t.pop("duration") * 1e3
+        return {"enabled": True, "threshold_ms": thr_ms,
+                "traces": traces}
+
     # -- handlers: data plane --------------------------------------------------
     def _completions(self, handler, body: dict):
         prompt = body.get("prompt")
@@ -312,10 +424,20 @@ class HTTPFrontend:
         rid = body.get("id") or uuid.uuid4().hex
         stream = bool(body.get("stream", True))
         events: "queue.Queue[dict]" = queue.Queue()
+        # the request's ROOT span: children (queue wait, admission,
+        # engine work — possibly on another host) parent here, so one
+        # /v1/completions = one connected trace.  An inbound trace
+        # context (an upstream proxy's headers) is adopted as parent.
+        root = _tracing.start_span(
+            "http.request", activate=False,
+            ctx=_tracing.extract_headers(handler.headers),
+            attrs={"rid": str(rid), "path": "/v1/completions"})
         kw = dict(max_new_tokens=int(body.get("max_tokens",
                                               self.default_max_tokens)),
                   priority=int(body.get("priority", 0)),
                   on_event=events.put)
+        if root is not _tracing.NULL_SPAN:
+            kw["trace_ctx"] = root.context()
         if body.get("eos_token_id") is not None:
             kw["eos_token_id"] = int(body["eos_token_id"])
         if body.get("deadline") is not None:
@@ -330,9 +452,11 @@ class HTTPFrontend:
         try:
             self.target.submit(rid, prompt, **kw)
         except RejectedError as e:
+            root.set_attr("status", 429).end()
             handler._json(429, {"error": str(e), "id": rid})
             return
         except EnforceError as e:
+            root.set_attr("status", 400).end()
             handler._json(400, {"error": str(e), "id": rid})
             return
         try:
@@ -341,7 +465,32 @@ class HTTPFrontend:
             else:
                 self._unary_response(handler, rid, events)
         finally:
+            self._log_if_slow(rid, root)
+            root.end()
             self._forget(rid)
+
+    def _log_if_slow(self, rid, root):
+        """One structured log line for a request whose TTFT crossed
+        the threshold — rid + trace_id is the handle an operator
+        pastes into /tracez (or the exported trace) to see WHY."""
+        if self.slow_ttft is None:
+            return
+        try:
+            tl = self.target.request_timeline(rid)
+        except Exception:
+            return
+        ttft = tl.get("ttft")
+        if ttft is None or ttft <= self.slow_ttft:
+            return
+        trace_id = tl.get("trace_id") or root.trace_id
+        _LOG.warning(
+            "slow request rid=%s trace_id=%s ttft=%.3fs "
+            "queue_wait=%s preemptions=%s state=%s n_tokens=%s",
+            rid, trace_id, ttft,
+            f"{tl['queue_wait']:.3f}s"
+            if tl.get("queue_wait") is not None else "?",
+            tl.get("preemptions"), tl.get("state"),
+            tl.get("n_tokens"))
 
     def _forget(self, rid):
         """Best-effort teardown after the response (or a client
@@ -437,6 +586,9 @@ class HTTPFrontend:
         except EnforceError as e:
             handler._json(400, {"error": str(e)})
         except Exception as e:
+            _tracing.record_event(
+                "error", where=f"http:{handler.path.split('?')[0]}",
+                error=f"{type(e).__name__}: {e}")
             handler._json(500, {"error": f"{type(e).__name__}: {e}"})
         else:
             handler._json(200, out if isinstance(out, dict) else {})
@@ -458,6 +610,12 @@ class HTTPFrontend:
             kw["deadline"] = float(body["deadline"])
         if body.get("max_queue_time") is not None:
             kw["max_queue_time"] = float(body["max_queue_time"])
+        # cross-host trace context rides in the HEADERS (the remote
+        # transport put it there): adopt it so this host's spans join
+        # the submitter's trace
+        ctx = _tracing.extract_headers(handler.headers)
+        if ctx is not None:
+            kw["trace_ctx"] = ctx
 
         def submit():
             if self.target.knows(rid):
@@ -503,6 +661,12 @@ class HTTPFrontend:
             return {"id": rid}
 
         self._guarded(handler, forget)
+
+    def _cp_timeline(self, handler, body: dict):
+        rid = body.get("id")
+        self._guarded(handler, lambda: {
+            "id": rid,
+            "timeline": self.target.request_timeline(rid)})
 
     def _cp_drain(self, handler, body: dict):
         resume = body.get("mode") == "resume"

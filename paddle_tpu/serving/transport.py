@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional
 from ..common.errors import (InvalidArgumentError, UnavailableError,
                              enforce)
 from ..observability import get_registry
+from ..observability import tracing as _tracing
 from .scheduler import RejectedError
 
 __all__ = ["RemoteReplica", "HealthProber", "TransportError",
@@ -140,6 +141,10 @@ class RemoteReplica:
         self._metrics = True
 
     def _count_error(self, err: BaseException):
+        # the flight recorder keeps the last transport failures for
+        # /statusz and crash dumps (no-op unless one is enabled)
+        _tracing.record_event("error", where=f"transport:{self.name}",
+                              error=f"{type(err).__name__}: {err}")
         if self._metrics is None:
             return
         if isinstance(err, TimeoutError):
@@ -165,14 +170,18 @@ class RemoteReplica:
     def _call(self, op: str, method: str, path: str,
               payload: Optional[dict] = None,
               timeout: Optional[float] = None,
-              retries: Optional[int] = None) -> dict:
+              retries: Optional[int] = None,
+              headers: Optional[dict] = None) -> dict:
         """One logical backend call: per-attempt timeout, bounded
         exponential backoff with jitter between attempts, fault-plan
         hooks around the wire work.  Overload (429) and bad requests
         (4xx) raise immediately — retrying them cannot help; transient
-        transport errors and 5xx retry up to ``retries`` attempts."""
+        transport errors and 5xx retry up to ``retries`` attempts.
+        ``headers`` ride on every attempt (trace-context
+        propagation)."""
         timeout = self.timeout if timeout is None else timeout
         attempts = (self.max_retries if retries is None else retries) + 1
+        extra_headers = dict(headers) if headers else {}
         body = None if payload is None else \
             json.dumps(payload).encode("utf-8")
         last_err: Optional[BaseException] = None
@@ -193,6 +202,7 @@ class RemoteReplica:
                 try:
                     headers = {"Content-Type": "application/json"} \
                         if body is not None else {}
+                    headers.update(extra_headers)
                     conn.request(method, path, body, headers)
                     resp = conn.getresponse()
                     raw = resp.read()
@@ -235,12 +245,17 @@ class RemoteReplica:
                eos_token_id: Optional[int] = None, priority: int = 0,
                deadline: Optional[float] = None,
                max_queue_time: Optional[float] = None,
-               on_event: Optional[Callable[[dict], None]] = None):
+               on_event: Optional[Callable[[dict], None]] = None,
+               trace_ctx: Optional[dict] = None):
         """Submit one request to the backend.  The streaming callback
         stays CLIENT-side (``step()`` synthesizes its events from
         polls); the wire carries only JSON.  Idempotent by rid: a
         retried submit whose first attempt was admitted but lost its
-        reply acks as a duplicate instead of double-admitting."""
+        reply acks as a duplicate instead of double-admitting.
+        ``trace_ctx`` propagates in HTTP HEADERS (not the body), so
+        the far scheduler's spans join the submitter's trace — a
+        retried or failed-over request still yields one connected
+        cross-host trace."""
         rid = str(rid)
         payload = {"id": rid, "prompt": list(prompt_ids),
                    "max_tokens": max_new_tokens, "priority": priority}
@@ -250,7 +265,8 @@ class RemoteReplica:
             payload["deadline"] = deadline
         if max_queue_time is not None:
             payload["max_queue_time"] = max_queue_time
-        self._call("submit", "POST", "/v1/submit", payload)
+        self._call("submit", "POST", "/v1/submit", payload,
+                   headers=_tracing.inject_headers(trace_ctx))
         with self._lock:
             self._track[rid] = _Tracked(on_event)
         return rid
@@ -438,6 +454,21 @@ class RemoteReplica:
 
     def metrics_snapshot(self) -> dict:
         return self._call("poll", "GET", "/v1/stats")
+
+    def request_timeline(self, rid) -> dict:
+        """The backend's per-request timing breakdown
+        (``POST /v1/timeline``) — timestamps are the BACKEND's
+        monotonic clock; only the derived fields (queue_wait, ttft)
+        compare across hosts."""
+        out = self._call("poll", "POST", "/v1/timeline",
+                         {"id": str(rid)})
+        return out.get("timeline", out)
+
+    def requests_overview(self) -> List[dict]:
+        """Live requests on the backend (``GET /v1/requests``) — the
+        /statusz table row source for remote replicas."""
+        out = self._call("poll", "GET", "/v1/requests")
+        return list(out.get("requests", []))
 
     # -- migration -------------------------------------------------------------
     def migrate_out(self, rid) -> Optional[dict]:
