@@ -1603,6 +1603,116 @@ def bench_longseq():
                       "final_loss": float(np.asarray(jax.device_get(loss)))}}
 
 
+def bench_serving_ragged():
+    """Ragged-unified-step row (ISSUE 12): decode latency under a
+    long-prompt + decode-heavy overload mix.  The split-program engine
+    prefills an admitted prompt synchronously (chunk dispatches back
+    to back), stalling every in-flight decode for the whole prompt —
+    the head-of-line problem ROADMAP open item 2 named.  The ragged
+    unified step packs the prompt's chunks INTO the decode batch (one
+    compiled mixed program, per-sequence descriptors as traced
+    scalars), so decode token inter-arrival stays near pure-decode
+    TPOT while the prefill streams through.  Headline: p99 decode
+    TPOT ratio split/unified (>1 = unified absorbs the prefill burst
+    better); tokens stay bit-identical (tests/test_ragged_mixed.py
+    pins that), so this row is pure scheduling latency.  Interleaved
+    best-of reps keep 1-core scheduling noise honest."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=4096)
+        seqs, page, maxlen = 8, 128, 4096
+        n_dec, new_dec = 6, 160
+        n_long, plen_long, new_long = 2, 1536, 16
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        seqs, page, maxlen = 4, 8, 64
+        n_dec, new_dec = 3, 24
+        n_long, plen_long, new_long = 1, 40, 4
+        dtype = np.float32
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    dec_prompts = [rng.integers(1, cfg.vocab_size, 4).tolist()
+                   for _ in range(n_dec)]
+    long_prompts = [rng.integers(1, cfg.vocab_size, plen_long).tolist()
+                    for _ in range(n_long)]
+
+    def run(unified):
+        eng = LLMEngine(model, max_seqs=seqs, max_len=maxlen,
+                        page_size=page, dtype=dtype,
+                        enable_prefix_caching=False,
+                        unified_step=unified)
+        sched = Scheduler(eng, max_queue=64, chunked_prefill=unified)
+        arriv = {}
+        for i, p in enumerate(dec_prompts):
+            sched.submit(f"d{i}", p, max_new_tokens=new_dec)
+            arriv[f"d{i}"] = []
+        submitted = False
+        t0 = time.perf_counter()
+        while sched.busy():
+            out = sched.step()
+            now = time.perf_counter()
+            for rid, toks in out.items():
+                if rid in arriv:
+                    arriv[rid].extend([now] * len(toks))
+            if not submitted and arriv and \
+                    min(len(a) for a in arriv.values()) >= 3:
+                # every decode is mid-stream: NOW the prompt arrives
+                for j in range(n_long):
+                    sched.submit(f"L{j}", long_prompts[j],
+                                 max_new_tokens=new_long)
+                submitted = True
+        wall = time.perf_counter() - t0
+        total = sum(len(sched.result(f"d{i}")) for i in range(n_dec))
+        total += sum(len(sched.result(f"L{j}")) for j in range(n_long))
+        gaps = np.concatenate([np.diff(np.asarray(a))
+                               for a in arriv.values() if len(a) > 1])
+        return total / wall, gaps
+
+    for uni in (False, True):
+        run(uni)                                  # warmup: compiles
+    reps = 2 if on_tpu else 3
+    best = {}
+    for _ in range(reps):
+        for label, uni in (("split", False), ("unified", True)):
+            tps, gaps = run(uni)                  # interleaved best-of
+            if label not in best or tps > best[label][0]:
+                best[label] = (tps, gaps)
+    p = {label: {q: float(np.percentile(g, q) * 1e3)
+                 for q in (50, 99)}
+         for label, (_, g) in best.items()}
+    ratio = p["split"][99] / p["unified"][99]
+    return {
+        "metric": "llama_serving_ragged_p99_decode_tpot_ratio",
+        "value": round(ratio, 3),
+        "unit": "x split-program p99 decode TPOT (>1 = unified "
+                "absorbs concurrent prefill better)",
+        "extra": {"device_kind": kind, "decode_slots": n_dec,
+                  "decode_new_tokens": new_dec,
+                  "long_prompts": n_long, "long_prompt_len": plen_long,
+                  "prefill_token_budget": page,
+                  "tpot_p50_ms_split": round(p["split"][50], 3),
+                  "tpot_p99_ms_split": round(p["split"][99], 3),
+                  "tpot_p50_ms_unified": round(p["unified"][50], 3),
+                  "tpot_p99_ms_unified": round(p["unified"][99], 3),
+                  "tokens_per_sec_split": round(best["split"][0], 1),
+                  "tokens_per_sec_unified": round(best["unified"][0], 1),
+                  "mixed_compiles": LLMEngine.mixed_compiles(),
+                  "prefill_compiles": LLMEngine.prefill_compiles(),
+                  "decode_compiles": LLMEngine.decode_compiles()}}
+
+
 def verify_dropout_smoke():
     """TPU-only dropout numerics smoke (VERDICT r3 Weak #6): the twin
     of the two CPU-perma-skipped tests in tests/test_pallas_flash.py
@@ -1671,6 +1781,7 @@ def main():
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),
                ("bench_serving_drain", bench_serving_drain),
+               ("bench_serving_ragged", bench_serving_ragged),
                ("bench_ckpt", bench_ckpt),
                ("bench_train_fused", bench_train_fused),
                ("bench_engine_window", bench_engine_window),

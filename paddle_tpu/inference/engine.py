@@ -46,6 +46,17 @@ unpreempted run (greedy decoding; the sampling strategy's key stream
 is global per step, so preemption reshuffles it by construction) and
 no new prefill compilations.
 
+Ragged unified step (``unified_step=True``, default): ``step()``
+dispatches ONE compiled mixed-batch program (``_paged_mixed_step``)
+that packs every active decode slot (compacted host-side — retired
+slots cost nothing) plus up to ``prefill_token_budget`` tokens of
+pending ``begin_request`` prefill chunks.  Descriptors are traced
+scalars, so ``mixed_compiles() == 1`` across arbitrary batch mixes,
+and a long prompt no longer stalls in-flight decodes (ROADMAP open
+item 2).  ``add_request`` remains the synchronous admission path;
+tokens are bit-identical between the unified and split programs
+(greedy decoding).
+
 Automatic prefix caching (``enable_prefix_caching=``, default on):
 admission looks up the longest cached page-aligned prefix of the
 prompt in the paged cache's chain-hash index, maps those pages into
@@ -96,6 +107,10 @@ class GenRequest:
         # only (maybe) a host swap-pool entry
         self.suspended = False
         self.swap_handle: Optional[int] = None
+        # unified-step chunked admission (begin_request): next prompt
+        # position to prefill, and the submit time TTFT measures from
+        self.pf_pos = 0
+        self.t_submit: Optional[float] = None
 
 
 def _wout(w) -> int:
@@ -379,6 +394,117 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     return toks, k_pages, v_pages, k_scales, v_scales
 
 
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
+def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
+                      k_pages, v_pages, k_scales, v_scales,
+                      ids, positions, row_tables,
+                      q_start, q_len, kv_len, desc_tables,
+                      desc_of_row, off_of_row, key, *,
+                      eps: float, kvh: int, head_dim: int,
+                      transpose_head: bool = False,
+                      strategy: str = "greedy_search", top_k: int = 0,
+                      top_p: float = 1.0, temperature: float = 1.0):
+    """ONE compiled program for the whole MIXED prefill+decode batch
+    (the ragged unified step): a flat token batch of T rows — every
+    active decode slot contributes 1 row, each pending prefill chunk
+    up to page_size rows — runs the full decoder once, appending every
+    row's K/V at its own position and attending each row over its own
+    sequence's pages under the causal mask ``kv_pos <= position``.
+
+    All batch-mix information is TRACED data (row ids/positions/tables
+    and the per-descriptor (q_start, q_len, kv_len) scalars the TPU
+    kernel prefetches), so one XLA program serves every interleaving —
+    ``mixed_compiles() == 1`` however prefill chunks and decode slots
+    mix.  On TPU the attention+append is the ragged Pallas kernel
+    (descriptor outputs gathered back to flat rows via the host-built
+    (desc_of_row, off_of_row) map); on CPU it is the per-row jnp
+    mirror, bit-compatible with the split prefill/decode programs.
+
+    ids/positions [T] int32 (position = the row's kv length before its
+    own append); row_tables [T, maxp]; q_start/q_len/kv_len [S] with
+    ``q_len == 0`` marking unused descriptors; desc_tables [S, maxp].
+    Dead padding rows carry position 0 and the all-zero table — their
+    writes land in the reserved pad page.  Returns (next_token [T],
+    k_pages', v_pages', k_scales', v_scales', key') — the key chains
+    across host-driven multi-token windows."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+    from ..ops.pallas.paged_attention import (
+        ragged_paged_append_attend, ragged_paged_append_attend_reference)
+    from ..runtime.device import is_compiled_with_tpu
+
+    cos_t, sin_t = rope
+    t = ids.shape[0]
+
+    from ..models.llama import _rotate_half as rotate_half
+    from ..nn.generation import sample_logits
+
+    x = jnp.take(embed_w, ids, axis=0)             # [T, H]
+    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [T, 1, D]
+    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
+    on_tpu = is_compiled_with_tpu()
+
+    def layer(carry, xs):
+        hcur = carry
+        lp, kp, vp, ksp, vsp = xs              # per-layer params + pools
+        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
+        nh = _wout(qw) // head_dim
+        q = _mm(hn, qw).reshape(t, nh, head_dim)
+        k = _mm(hn, kw).reshape(t, kvh, head_dim)
+        v = _mm(hn, vw).reshape(t, kvh, head_dim)
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
+        k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
+        if on_tpu:
+            # ragged kernel: per-descriptor [P, H, D] output blocks,
+            # gathered back to the flat row order
+            if ksp is None:
+                blocks, kp, vp = ragged_paged_append_attend(
+                    q, kp, vp, k, v, q_start, q_len, kv_len,
+                    desc_tables)
+            else:
+                blocks, kp, vp, ks4, vs4 = ragged_paged_append_attend(
+                    q, kp, vp, k, v, q_start, q_len, kv_len,
+                    desc_tables, ksp[:, :, None, :],
+                    vsp[:, :, None, :])
+                ksp = ks4.reshape(ksp.shape)
+                vsp = vs4.reshape(vsp.shape)
+            attn = blocks[desc_of_row, off_of_row]          # [T, NH, D]
+        elif ksp is None:
+            attn, kp, vp = ragged_paged_append_attend_reference(
+                q, kp, vp, k, v, positions, row_tables)
+        else:
+            attn, kp, vp, ks4, vs4 = \
+                ragged_paged_append_attend_reference(
+                    q, kp, vp, k, v, positions, row_tables,
+                    ksp[:, :, None, :], vsp[:, :, None, :])
+            ksp = ks4.reshape(ksp.shape)
+            vsp = vs4.reshape(vsp.shape)
+        hcur = hcur + _mm(attn.reshape(t, nh * head_dim), ow)
+        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
+        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
+        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
+
+    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    x = _nn.rms_norm(x, norm_w, epsilon=eps)
+    logits = jnp.matmul(x, head_w.T) if transpose_head \
+        else _mm(x, head_w)
+    key, sub = jax.random.split(key)
+    nxt, _ = sample_logits(logits, sub, strategy=strategy,
+                           top_k=top_k, top_p=top_p,
+                           temperature=temperature)
+    return nxt, k_pages, v_pages, k_scales, v_scales, key
+
+
 class LLMEngine:
     """Continuous batching for LlamaForCausalLM-shaped models."""
 
@@ -392,7 +518,9 @@ class LLMEngine:
                  weight_dtype: Optional[str] = None,
                  enable_metrics: bool = True,
                  enable_prefix_caching: bool = True,
-                 swap_pool_pages: Optional[int] = None):
+                 swap_pool_pages: Optional[int] = None,
+                 unified_step: bool = True,
+                 prefill_token_budget: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -419,6 +547,19 @@ class LLMEngine:
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
         self.enable_prefix_caching = bool(enable_prefix_caching)
+        # ragged unified step: ONE compiled program serves every mixed
+        # prefill+decode batch.  The STATIC prefill-token budget sizes
+        # the flat batch (T = max_seqs + budget rows); the runtime
+        # budget (``prefill_token_budget`` attribute) can be lowered
+        # per step — e.g. by a scheduler's decode-latency SLO loop —
+        # without recompiling, since T never changes.
+        self.unified_step = bool(unified_step)
+        self._pf_budget_static = int(prefill_token_budget) \
+            if prefill_token_budget is not None else page_size
+        enforce(self._pf_budget_static >= 1,
+                "prefill_token_budget must be >= 1")
+        self.prefill_token_budget = self._pf_budget_static
+        self._prefilling: List[GenRequest] = []
         # host-side prefix-cache stats (kept even with metrics off —
         # the bench and tests read them directly)
         self.prefix_stats = {"hit_tokens": 0, "miss_tokens": 0,
@@ -598,6 +739,15 @@ class LLMEngine:
                 "Cumulative cached / total prompt tokens (0 when "
                 "prefix caching is off or nothing admitted).",
                 lbl).labels(eid),
+            "mixed_decode_slots": reg.gauge(
+                "llm_engine_mixed_batch_decode_slots",
+                "Decode rows packed into the last unified mixed "
+                "step.", lbl).labels(eid),
+            "mixed_prefill_tokens": reg.gauge(
+                "llm_engine_mixed_batch_prefill_tokens",
+                "Prefill-chunk tokens packed into the last unified "
+                "mixed step (interleave ratio = this / (this + decode "
+                "slots)).", lbl).labels(eid),
         }
         # compile-count gauges are process-global (the jit caches are),
         # unlabeled: any drift past 1 means a recompile regression —
@@ -609,11 +759,16 @@ class LLMEngine:
             "llm_engine_decode_compiles",
             "Distinct compiled decode programs (expected: ~1, at most "
             "log2(steps_per_sync) window buckets).")
+        self._metrics["mixed_compiles"] = reg.gauge(
+            "llm_engine_mixed_compiles",
+            "Distinct compiled unified mixed-step programs "
+            "(expected: 1 per engine geometry).")
 
     def _record_compiles(self):
         m = self._metrics
         m["prefill_compiles"].set(self.prefill_compiles())
         m["decode_compiles"].set(self.decode_compiles())
+        m["mixed_compiles"].set(self.mixed_compiles())
 
     # -- prefill / replay internals --------------------------------------------
     def _prefill_seq(self, slot, seq, start_chunk: int):
@@ -813,12 +968,84 @@ class LLMEngine:
             self._metrics["queue_depth"].set(len(self._active))
         return rid
 
+    def begin_request(self, rid, prompt_ids, max_new_tokens: int = 64,
+                      eos_token_id: Optional[int] = None):
+        """DEFERRED admission for the ragged unified step: reserve the
+        slot and page budget now, but run the prompt's prefill inside
+        subsequent ``step()`` calls — page-sized chunks ride the same
+        mixed-batch dispatch as every ongoing decode, up to the
+        per-step ``prefill_token_budget``, so a long prompt never
+        stalls in-flight decodes (the chunk-level-admission half of
+        the head-of-line fix; ``add_request`` remains the synchronous
+        prefill-then-join path).  The first token arrives in a later
+        ``step()`` return value, exactly like every other token.
+        Prefix caching applies as in ``add_request``: cached pages map
+        in host-side and the chunk stream starts at the first uncached
+        position."""
+        enforce(self.unified_step,
+                "begin_request requires unified_step=True (the split-"
+                "program engine admits synchronously via add_request)")
+        enforce(rid not in self.requests, f"duplicate request id {rid!r}")
+        enforce(max_new_tokens >= 1, "max_new_tokens must be >= 1")
+        req = GenRequest(rid, prompt_ids, max_new_tokens, eos_token_id)
+        plen = len(req.prompt)
+        enforce(plen >= 1, "empty prompt")
+        total = plen + max_new_tokens
+        limit = min(self.max_len,
+                    self.model.config.max_position_embeddings)
+        enforce(total <= limit,
+                f"prompt ({plen}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the engine/model limit "
+                f"{limit}")
+        P = self.cache.page_size
+        cached, shared_pages = 0, []
+        if self.enable_prefix_caching:
+            cacheable = ((plen - 1) // P) * P
+            cached, shared_pages = self.cache.lookup_prefix(
+                req.prompt[:cacheable])
+        req.slot = self.cache.allocate(total, shared_pages=shared_pages)
+        req.pf_pos = cached
+        req.t_submit = time.perf_counter()
+        self.requests[rid] = req
+        self._prefilling.append(req)
+        st = self.prefix_stats
+        st["hit_tokens"] += cached
+        st["miss_tokens"] += plen - cached
+        st["shared_pages"] += len(shared_pages)
+        st["hit_requests" if cached else "miss_requests"] += 1
+        if self._metrics is not None:
+            m = self._metrics
+            m["prompt_tokens"].inc(plen)
+            m["requests"].inc()
+            m["prefix_hit_tokens"].inc(cached)
+            m["prefix_miss_tokens"].inc(plen - cached)
+            m["prefix_shared_pages"].inc(len(shared_pages))
+            seen = st["hit_tokens"] + st["miss_tokens"]
+            m["prefix_hit_rate"].set(st["hit_tokens"] / seen
+                                     if seen else 0.0)
+        return rid
+
     # -- decode loop -----------------------------------------------------------
     def step(self) -> Dict[object, List[int]]:
+        """One serving step: returns {request_id: [new tokens]} and
+        retires finished requests (streaming callers see every
+        intermediate token).
+
+        With ``unified_step=True`` (default) this is the RAGGED MIXED
+        step: one compiled program packs every active decode slot plus
+        up to ``prefill_token_budget`` tokens of pending
+        ``begin_request`` prefill chunks — prefill rides alongside
+        decode instead of stalling it.  Tokens are bit-identical to
+        the split-program path (greedy decoding; the per-row programs
+        agree op for op).  With ``unified_step=False`` the original
+        split decode-only dispatch runs (``_paged_decode_step``)."""
+        if self.unified_step:
+            return self._step_mixed()
+        return self._step_split()
+
+    def _step_split(self) -> Dict[object, List[int]]:
         """Decode up to ``steps_per_sync`` tokens for every active
-        request in one device dispatch; returns {request_id: [new
-        tokens this window]} and retires finished requests (streaming
-        callers see every intermediate token).  The host only
+        request in one device dispatch.  The host only
         syncs (EOS checks, admission window) once per call, so over a
         high-latency dispatch path (remote PJRT) throughput scales with
         steps_per_sync; the window never exceeds any request's
@@ -907,8 +1134,198 @@ class LLMEngine:
             self._record_compiles()
         return out
 
+    def _step_mixed(self) -> Dict[object, List[int]]:
+        """The ragged unified step: ONE ``_paged_mixed_step`` dispatch
+        carries every active decode slot (1 row each — the slot→row
+        map is compacted host-side, no padded dead slots) plus pending
+        prefill chunks packed FIFO up to the runtime
+        ``prefill_token_budget`` (chunks never cross page boundaries,
+        so one request may contribute several descriptors).  When no
+        prefill is pending, the ``steps_per_sync`` window runs as
+        host-chained single-token dispatches of the SAME program —
+        never a second compiled shape."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self._active and not self._prefilling:
+            return {}
+        P = self.cache.page_size
+        maxp = self.cache.page_table.shape[1]
+        t_cap = self.max_seqs + self._pf_budget_static
+        batch = list(self._active)
+        n = len(batch)
+
+        # prefill plan: (req, pos, chunk_len, first_row, descriptor).
+        # The runtime budget is clamped to the static one (T is fixed)
+        # and floored at 1 when only prefill is pending — a zero
+        # budget must not livelock has_work().
+        budget = max(0, min(int(self.prefill_token_budget),
+                            self._pf_budget_static))
+        if not batch and budget == 0:
+            budget = min(P, self._pf_budget_static)
+        plan = []
+        finishing = []                        # (req, last_row)
+        cursor, desc_i, used = n, n, 0
+        for req in self._prefilling:
+            plen = len(req.prompt)
+            pos = req.pf_pos
+            while pos < plen and used < budget:
+                cl = min(P - pos % P, plen - pos, budget - used)
+                plan.append((req, pos, cl, cursor, desc_i))
+                pos += cl
+                cursor += cl
+                used += cl
+                desc_i += 1
+            if pos >= plen:
+                finishing.append((req, cursor - 1))
+            if used >= budget:
+                break
+        if not batch and not plan:
+            return {}
+
+        if plan or n == 0:
+            nsteps = 1
+        else:
+            nsteps = min([self.steps_per_sync] +
+                         [r.max_new - len(r.out) for r in batch])
+            nsteps = max(nsteps, 1)
+            while nsteps & (nsteps - 1):
+                nsteps &= nsteps - 1
+        slots = np.array([r.slot for r in batch], np.int64)
+        for r in batch:
+            self.cache.extend(r.slot, nsteps)
+
+        ids = np.zeros(t_cap, np.int32)
+        positions = np.zeros(t_cap, np.int32)
+        row_tables = np.zeros((t_cap, maxp), np.int32)
+        q_start = np.zeros(t_cap, np.int32)
+        q_len = np.zeros(t_cap, np.int32)
+        kv_len = np.zeros(t_cap, np.int32)
+        desc_tables = np.zeros((t_cap, maxp), np.int32)
+        # padding rows point at their own (q_len == 0) descriptor,
+        # whose kernel output block is zeroed — never garbage
+        desc_of_row = np.arange(t_cap, dtype=np.int32)
+        off_of_row = np.zeros(t_cap, np.int32)
+        if n:
+            ids[:n] = [r.out[-1] for r in batch]
+            lens = self.cache.seq_lens[slots]
+            positions[:n] = lens
+            row_tables[:n] = self.cache.page_table[slots]
+            q_start[:n] = np.arange(n)
+            q_len[:n] = 1
+            kv_len[:n] = lens
+            desc_tables[:n] = row_tables[:n]
+        for req, pos, cl, row0, d in plan:
+            tbl = self.cache.page_table[req.slot]
+            ids[row0:row0 + cl] = req.prompt[pos:pos + cl]
+            positions[row0:row0 + cl] = np.arange(pos, pos + cl)
+            row_tables[row0:row0 + cl] = tbl
+            q_start[d] = row0
+            q_len[d] = cl
+            kv_len[d] = pos
+            desc_tables[d] = tbl
+            desc_of_row[row0:row0 + cl] = d
+            off_of_row[row0:row0 + cl] = np.arange(cl)
+
+        self._key, sub = jax.random.split(self._key)
+        key = sub
+        toks_all = []
+        t_win = time.perf_counter()
+        span = _tracing.span("engine.mixed_step")
+        span.set_attr("decode_slots", n)
+        span.set_attr("prefill_tokens", int(used))
+        span.set_attr("nsteps", nsteps)
+        try:
+            with RecordEvent("llm_engine.decode"):
+                for si in range(nsteps):
+                    (nxt, self.cache.k_pages, self.cache.v_pages,
+                     self.cache.k_scales, self.cache.v_scales, key) = \
+                        _paged_mixed_step(
+                            self._stack, self._norm_w, self._head_w,
+                            self._embed_w, self._rope,
+                            self.cache.k_pages, self.cache.v_pages,
+                            self.cache.k_scales, self.cache.v_scales,
+                            jnp.asarray(ids), jnp.asarray(positions),
+                            jnp.asarray(row_tables),
+                            jnp.asarray(q_start), jnp.asarray(q_len),
+                            jnp.asarray(kv_len),
+                            jnp.asarray(desc_tables),
+                            jnp.asarray(desc_of_row),
+                            jnp.asarray(off_of_row), key,
+                            eps=self.eps, kvh=self.kvh,
+                            head_dim=self.head_dim,
+                            transpose_head=self._tied,
+                            strategy=self.decode_strategy,
+                            top_k=self.top_k, top_p=self.top_p,
+                            temperature=self.temperature)
+                    nxt = np.asarray(jax.device_get(nxt))
+                    toks_all.append(nxt)
+                    if n:
+                        self.cache.advance(slots, 1)
+                    if si + 1 < nsteps:
+                        # host-chained window (pure decode): feed each
+                        # slot's sampled token back as the next input
+                        ids[:n] = nxt[:n]
+                        positions[:n] += 1
+                        kv_len[:n] += 1
+        finally:
+            span.end()
+        dt_win = time.perf_counter() - t_win
+
+        out = {}
+        for i, req in enumerate(batch):
+            new_toks = []
+            for j in range(nsteps):
+                if req.done:
+                    break
+                tok = int(toks_all[j][i])
+                req.out.append(tok)
+                new_toks.append(tok)
+                if (req.eos is not None and tok == req.eos) or \
+                        len(req.out) >= req.max_new:
+                    req.done = True
+                    self.cache.release(req.slot)
+                    self._active.remove(req)
+            if new_toks:
+                out[req.rid] = new_toks
+
+        # prefill bookkeeping AFTER the dispatch succeeded — a raise
+        # above leaves every pf_pos where it was (no token lost)
+        for req, pos, cl, row0, d in plan:
+            req.pf_pos = pos + cl
+        for req, last_row in finishing:
+            first = int(toks_all[0][last_row])
+            plen = len(req.prompt)
+            self.cache.set_len(req.slot, plen)
+            if self.enable_prefix_caching:
+                self.cache.register_prefix(req.slot, req.prompt,
+                                           upto=(plen // P) * P)
+            req.out.append(first)
+            self._prefilling.remove(req)
+            out[req.rid] = [first]
+            if self._metrics is not None and req.t_submit is not None:
+                self._metrics["ttft"].observe(
+                    time.perf_counter() - req.t_submit)
+            if (req.eos is not None and first == req.eos) or \
+                    req.max_new <= 1:
+                req.done = True
+                self.cache.release(req.slot)
+            else:
+                self._active.append(req)
+        if self._metrics is not None:
+            m = self._metrics
+            m["tpot"].observe(dt_win / nsteps, n=nsteps)
+            m["generated_tokens"].inc(
+                sum(len(v) for v in out.values()))
+            m["queue_depth"].set(len(self._active))
+            m["occupancy"].set(n / self.max_seqs)
+            m["mixed_decode_slots"].set(n)
+            m["mixed_prefill_tokens"].set(used)
+            self._record_compiles()
+        return out
+
     def has_work(self) -> bool:
-        return bool(self._active)
+        return bool(self._active or self._prefilling)
 
     # -- admission-control introspection ---------------------------------------
     def free_slots(self) -> int:
@@ -957,6 +1374,24 @@ class LLMEngine:
         req = self.requests[rid]
         enforce(not req.done, f"request {rid!r} already retired")
         enforce(not req.suspended, f"request {rid!r} already suspended")
+        if req in self._prefilling:
+            # mid-prefill preemptee (begin_request, prefill not done):
+            # its partial KV is cheaper to recompute than to swap —
+            # release the pages outright; resume restarts the chunk
+            # stream (prefix-cache hits still skip cached pages)
+            self._prefilling.remove(req)
+            with _tracing.span("engine.swap_out") as sp:
+                self.cache.release(req.slot)
+                req.swap_handle = None
+                sp.set_attr("rid", str(rid))
+                sp.set_attr("armed", False)
+            req.slot = None
+            req.suspended = True
+            req.pf_pos = 0
+            if self._metrics is not None:
+                self._metrics["suspended"].inc()
+                self._metrics["queue_depth"].set(len(self._active))
+            return False
         self._active.remove(req)
         with _tracing.span("engine.swap_out") as sp:
             req.swap_handle = self.cache.swap_out(req.slot)
@@ -988,6 +1423,25 @@ class LLMEngine:
                 f"request {rid!r} is not suspended")
         plen = len(req.prompt)
         total = plen + req.max_new
+        if not req.out:
+            # mid-prefill preemptee: re-reserve its budget and rejoin
+            # the unified step's chunk stream — the prefill that ran
+            # before the preemption recomputes (bit-identical rows)
+            P = self.cache.page_size
+            cached, shared_pages = 0, []
+            if self.enable_prefix_caching:
+                cacheable = ((plen - 1) // P) * P
+                cached, shared_pages = self.cache.lookup_prefix(
+                    req.prompt[:cacheable])
+            req.slot = self.cache.allocate(total,
+                                           shared_pages=shared_pages)
+            req.pf_pos = cached
+            req.suspended = False
+            self._prefilling.append(req)
+            if self._metrics is not None:
+                self._metrics["resumed"].labels(
+                    self.engine_id, "recompute").inc()
+            return "recompute"
         path = None
         if req.swap_handle is not None:
             with _tracing.span("engine.swap_in") as sp:
@@ -1132,6 +1586,9 @@ class LLMEngine:
         elif req in self._active:
             self._active.remove(req)
             self.cache.release(req.slot)
+        elif req in self._prefilling:
+            self._prefilling.remove(req)
+            self.cache.release(req.slot)
         if self._metrics is not None:
             self._metrics["aborted"].inc()
             self._metrics["queue_depth"].set(len(self._active))
@@ -1186,7 +1643,23 @@ class LLMEngine:
 
     @staticmethod
     def decode_compiles() -> int:
-        return _paged_decode_step._cache_size()
+        """Distinct compiled decode-side programs: the split
+        multi-step decode program's window buckets PLUS the unified
+        mixed-step program (the unified path's only decode program —
+        counted here so existing >=1 / unchanged-across-runs checks
+        keep holding on either path)."""
+        return _paged_decode_step._cache_size() + \
+            _paged_mixed_step._cache_size()
+
+    @staticmethod
+    def mixed_compiles() -> int:
+        """Distinct compiled unified mixed-step programs — 1 per
+        engine geometry for ANY interleaving of prefill chunks and
+        decode slots (every batch-mix input is traced data).  Like the
+        other counters this reads a process-global jit cache: assert
+        deltas, not absolutes, when several geometries share the
+        process."""
+        return _paged_mixed_step._cache_size()
 
     def metrics_snapshot(self) -> dict:
         """One JSON-able dict with everything an operator tunes
@@ -1201,9 +1674,13 @@ class LLMEngine:
             "engine": self.engine_id,
             "prefill_compiles": self.prefill_compiles(),
             "decode_compiles": self.decode_compiles(),
+            "mixed_compiles": self.mixed_compiles(),
+            "unified_step": self.unified_step,
+            "prefill_token_budget": int(self.prefill_token_budget),
             "kv_cache": self.cache.metrics_snapshot(),
             "kv_page_utilization": self.cache.page_utilization(),
             "active_requests": len(self._active),
+            "prefilling_requests": len(self._prefilling),
             "suspended_requests": self.suspended_count(),
             "free_slots": self.free_slots(),
             "prefix_caching": dict(
@@ -1222,5 +1699,9 @@ class LLMEngine:
                 "requests": int(m["requests"].value),
                 "queue_depth": m["queue_depth"].value,
                 "batch_occupancy": m["occupancy"].value,
+                "mixed_batch_decode_slots":
+                    m["mixed_decode_slots"].value,
+                "mixed_batch_prefill_tokens":
+                    m["mixed_prefill_tokens"].value,
             })
         return snap

@@ -32,6 +32,24 @@ s[g, t] (shape [G, P] × [1, P]) and the V scale folds into the softmax
 probabilities before the PV matmul.  The int8 page + its scale row
 stream through the same _NBUF-deep DMA pipeline; HBM traffic per page
 drops ~2× vs fp16 (page bytes P·D → P·D + 4·P for the scales).
+
+RAGGED MIXED MODE (``ragged_paged_append_attend``): one dispatch serves
+a whole mixed prefill+decode batch.  The flat token batch carries
+per-sequence descriptors ``(q_start, q_len, kv_len)`` — a decode slot
+contributes one query row (q_len == 1), a prefill chunk up to
+``page_size`` rows, all landing inside ONE page (the engine chunks
+prompts at page boundaries, so ``kv_len % P + q_len <= P`` holds per
+descriptor).  The grid is (descriptor, kv-head); each step streams that
+sequence's pages through the same double-buffered pipeline, substitutes
+the chunk's freshly-projected K/V rows in registers (quantizing them
+per row first in int8 mode), applies the causal-within-chunk mask
+(``kv_pos <= kv_len + row``), and writes the ONE modified page (plus
+its scale row) back — the fused-append contract of the decode kernel,
+generalized to ragged row counts.  Grid steps run sequentially on TPU,
+so a long prompt split across several descriptors in one dispatch sees
+its earlier chunks' pages already written.  The jnp mirror
+(``ragged_paged_append_attend_reference``) is the CPU/oracle path the
+engine's mixed-step program uses off-TPU.
 """
 from __future__ import annotations
 
@@ -48,7 +66,10 @@ from .vma import out_sds
 __all__ = ["paged_attention_raw", "paged_attention_reference",
            "paged_write", "paged_write_quant",
            "paged_decode_append_attend",
-           "paged_decode_append_attend_reference"]
+           "paged_decode_append_attend_reference",
+           "ragged_paged_append_attend",
+           "ragged_paged_append_attend_reference",
+           "paged_write_rows", "paged_write_rows_quant"]
 
 _NEG_INF = float(-1e30)
 _LANES = 128
@@ -591,3 +612,493 @@ def paged_write_quant(k_pages, v_pages, k_scales, v_scales,
         v_scales = jax.lax.dynamic_update_slice(
             v_scales, vst[:, i][:, None, None, None], sidx)
     return k_pages, v_pages, k_scales, v_scales
+
+
+# -- ragged mixed prefill+decode (one kernel for the whole batch) -------------
+
+def _stream_pages_ragged(pt_ref, s_i, h, q2, k_hbm, v_hbm, k_scr, v_scr,
+                         sem, kv_len, q_len, npages, page_size, g,
+                         inject, quant=None):
+    """Online-softmax attention for ONE ragged descriptor's query rows
+    ([page_size·G, D] — rows past ``q_len`` are dead lanes) over its
+    pages, streamed with the same _NBUF pipeline as ``_stream_pages``.
+
+    Differences from the single-row streamer: the causal mask is
+    per-ROW (chunk row r sees kv positions <= kv_len + r), and
+    ``inject`` substitutes a BLOCK of rows ([base, base + q_len) of the
+    append page) instead of one — fp mode (append_page, rowsel [P,1],
+    k_rows [P,D], v_rows [P,D]); int8 mode additionally carries the
+    pre-quantized rows' lane-oriented scales and their lane selector
+    (…, k_scale_lane [1,P], v_scale_lane [1,P], lanesel [1,P]).
+
+    Returns (l, acc, writeback) like ``_stream_pages``."""
+    if quant is not None:
+        ks_hbm, vs_hbm, ks_scr, vs_scr = quant
+        ap, rowsel, krows, vrows, ksl, vsl, lanesel = inject
+    else:
+        ap, rowsel, krows, vrows = inject
+
+    def k_copy(i, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[h, pt_ref[s_i, i]], k_scr.at[slot], sem.at[slot, 0])
+
+    def v_copy(i, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[h, pt_ref[s_i, i]], v_scr.at[slot], sem.at[slot, 1])
+
+    def ks_copy(i, slot):
+        return pltpu.make_async_copy(
+            ks_hbm.at[h, pt_ref[s_i, i]], ks_scr.at[slot],
+            sem.at[slot, 2])
+
+    def vs_copy(i, slot):
+        return pltpu.make_async_copy(
+            vs_hbm.at[h, pt_ref[s_i, i]], vs_scr.at[slot],
+            sem.at[slot, 3])
+
+    def start(i, slot):
+        k_copy(i, slot).start()
+        v_copy(i, slot).start()
+        if quant is not None:
+            ks_copy(i, slot).start()
+            vs_copy(i, slot).start()
+
+    def wait(i, slot):
+        k_copy(i, slot).wait()
+        v_copy(i, slot).wait()
+        if quant is not None:
+            ks_copy(i, slot).wait()
+            vs_copy(i, slot).wait()
+
+    for j in range(_NBUF):
+        @pl.when(j < npages)
+        def _(j=j):
+            start(j, j)
+
+    rows = q2.shape[0]                                 # page_size · G
+    d = q2.shape[1]
+    m0 = jnp.full((rows, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows, 1), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
+
+    def body(i, carry):
+        if quant is not None:
+            m, l, acc, kmod, vmod, ksmod, vsmod = carry
+        else:
+            m, l, acc, kmod, vmod = carry
+        slot = jax.lax.rem(i, _NBUF)
+
+        wait(i, slot)
+        kpg = k_scr[slot]                              # [P, D]
+        vpg = v_scr[slot]
+        if quant is not None:
+            ks = ks_scr[slot]                          # [1, P] f32
+            vs = vs_scr[slot]
+        hit = i == ap
+        sel = jnp.logical_and(hit, rowsel)
+        kpg = jnp.where(sel, krows, kpg)
+        vpg = jnp.where(sel, vrows, vpg)
+        kmod = jnp.where(hit, kpg, kmod)
+        vmod = jnp.where(hit, vpg, vmod)
+        if quant is not None:
+            lsel = jnp.logical_and(hit, lanesel)
+            ks = jnp.where(lsel, ksl, ks)
+            vs = jnp.where(lsel, vsl, vs)
+            ksmod = jnp.where(hit, ks, ksmod)
+            vsmod = jnp.where(hit, vs, vsmod)
+        k = kpg.astype(jnp.float32)
+        v = vpg.astype(jnp.float32)
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if quant is not None:
+            s = s * ks
+        # causal-within-chunk: query row r (global position
+        # kv_len + r) sees kv positions <= kv_len + r
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // g
+        s = jnp.where(pos <= kv_len + row, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # [rows, P]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if quant is not None:
+            p = p * vs
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+
+        @pl.when(i + _NBUF < npages)
+        def _():
+            start(i + _NBUF, slot)
+        if quant is not None:
+            return (m_new, l_new, acc * alpha + pv, kmod, vmod,
+                    ksmod, vsmod)
+        return m_new, l_new, acc * alpha + pv, kmod, vmod
+
+    kz = jnp.zeros((page_size, d),
+                   jnp.int8 if quant is not None else k_scr.dtype)
+    if quant is not None:
+        sz = jnp.zeros((1, page_size), jnp.float32)
+        _, l, acc, kmod, vmod, ksmod, vsmod = jax.lax.fori_loop(
+            0, npages, body, (m0, l0, acc0, kz, kz, sz, sz))
+        return l, acc, (kmod, vmod, ksmod, vsmod)
+    _, l, acc, kmod, vmod = jax.lax.fori_loop(
+        0, npages, body, (m0, l0, acc0, kz, kz))
+    return l, acc, (kmod, vmod)
+
+
+def _ragged_kernel(qs_ref, ql_ref, kl_ref, pt_ref, q_hbm, kn_hbm,
+                   vn_hbm, k_in, v_in, *rest,
+                   scale, page_size, maxp, quantized):
+    if quantized:
+        (ks_in, vs_in, o_ref, k_out, v_out, ks_out, vs_out,
+         q_scr, kn_scr, vn_scr, k_scr, v_scr, w_scr, qsem, sem, wsem,
+         ks_scr, vs_scr, ws_scr) = rest
+        quant = (ks_in, vs_in, ks_scr, vs_scr)
+    else:
+        (o_ref, k_out, v_out,
+         q_scr, kn_scr, vn_scr, k_scr, v_scr, w_scr, qsem, sem,
+         wsem) = rest
+        quant = None
+    s_i, h = pl.program_id(0), pl.program_id(1)
+    q_start = qs_ref[s_i]
+    q_len = ql_ref[s_i]
+    kv_len = kl_ref[s_i]
+    P = page_size
+    g = q_scr.shape[1]
+    d = q_scr.shape[2]
+
+    @pl.when(q_len == 0)
+    def _():
+        # unused descriptor: zero its output block so the flat-row
+        # gather never reads uninitialized memory
+        o_ref[0, :, 0] = jnp.zeros((P, g, d), o_ref.dtype)
+
+    @pl.when(q_len > 0)
+    def _():
+        length = kv_len + q_len
+        npages = jnp.minimum((length + P - 1) // P, maxp)
+        ap = kv_len // P                    # the ONE page this chunk
+        base = kv_len - ap * P              # fills, from row ``base``
+
+        # q/k_new/v_new are front-padded by P rows, so these FIXED-size
+        # row copies take any dynamic start: q scratch row j is flat
+        # row q_start + j; the k/v scratch is loaded shifted by -base
+        # so its row r aligns with append-page row r (rows outside
+        # [base, base + q_len) are dead and deselected below)
+        qc = pltpu.make_async_copy(
+            q_hbm.at[pl.ds(P + q_start, P), h], q_scr, qsem.at[0])
+        knc = pltpu.make_async_copy(
+            kn_hbm.at[pl.ds(P + q_start - base, P), h], kn_scr,
+            qsem.at[1])
+        vnc = pltpu.make_async_copy(
+            vn_hbm.at[pl.ds(P + q_start - base, P), h], vn_scr,
+            qsem.at[2])
+        for c in (qc, knc, vnc):
+            c.start()
+        for c in (qc, knc, vnc):
+            c.wait()
+
+        riota = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+        rowsel = jnp.logical_and(riota >= base, riota < base + q_len)
+        knf = kn_scr[...]
+        vnf = vn_scr[...]
+        if quantized:
+            # per-row absmax quantize of the appended rows in registers
+            # (the quantize_rows_raw contract, like the decode kernel)
+            knf = knf.astype(jnp.float32)
+            vnf = vnf.astype(jnp.float32)
+            kamax = jnp.maximum(
+                jnp.max(jnp.abs(knf), axis=1, keepdims=True), EPS)
+            vamax = jnp.maximum(
+                jnp.max(jnp.abs(vnf), axis=1, keepdims=True), EPS)
+            ksr = kamax / QMAX                            # [P, 1]
+            vsr = vamax / QMAX
+            krows = jnp.clip(jnp.round(knf / ksr), -QMAX,
+                             QMAX).astype(jnp.int8)
+            vrows = jnp.clip(jnp.round(vnf / vsr), -QMAX,
+                             QMAX).astype(jnp.int8)
+            # rotate the sublane scale column into a LANE row without a
+            # transpose: ones[1,P] @ diag(scales) — the diagonal is a
+            # where() on a 2-D iota, all Mosaic-friendly shapes
+            eye = jax.lax.broadcasted_iota(jnp.int32, (P, P), 0) == \
+                jax.lax.broadcasted_iota(jnp.int32, (P, P), 1)
+            ones = jnp.ones((1, P), jnp.float32)
+            ksl = jax.lax.dot_general(
+                ones, jnp.where(eye, ksr, 0.0),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)       # [1, P]
+            vsl = jax.lax.dot_general(
+                ones, jnp.where(eye, vsr, 0.0),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            liota = jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+            lanesel = jnp.logical_and(liota >= base,
+                                      liota < base + q_len)
+            inject = (ap, rowsel, krows, vrows, ksl, vsl, lanesel)
+        else:
+            inject = (ap, rowsel, knf, vnf)
+
+        q2 = (q_scr[...].astype(jnp.float32) * scale).reshape(P * g, d)
+        l, acc, wb = _stream_pages_ragged(
+            pt_ref, s_i, h, q2, k_in, v_in, k_scr, v_scr, sem, kv_len,
+            q_len, npages, P, g, inject, quant=quant)
+        o = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        o_ref[0, :, 0] = o.reshape(P, g, d)
+
+        # write the modified append page (and its scale row) back with
+        # full-page DMAs — same contract as the decode append kernel
+        if quantized:
+            kmod, vmod, ksmod, vsmod = wb
+        else:
+            kmod, vmod = wb
+        w_scr[0] = kmod.astype(w_scr.dtype)
+        w_scr[1] = vmod.astype(w_scr.dtype)
+        copies = [
+            pltpu.make_async_copy(w_scr.at[0],
+                                  k_out.at[h, pt_ref[s_i, ap]],
+                                  wsem.at[0]),
+            pltpu.make_async_copy(w_scr.at[1],
+                                  v_out.at[h, pt_ref[s_i, ap]],
+                                  wsem.at[1]),
+        ]
+        if quantized:
+            ws_scr[0] = ksmod
+            ws_scr[1] = vsmod
+            copies += [
+                pltpu.make_async_copy(ws_scr.at[0],
+                                      ks_out.at[h, pt_ref[s_i, ap]],
+                                      wsem.at[2]),
+                pltpu.make_async_copy(ws_scr.at[1],
+                                      vs_out.at[h, pt_ref[s_i, ap]],
+                                      wsem.at[3]),
+            ]
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("scale",),
+                   donate_argnames=("k_pages", "v_pages",
+                                    "k_scales", "v_scales"))
+def ragged_paged_append_attend(q, k_pages, v_pages, k_new, v_new,
+                               q_start, q_len, kv_len, page_tables,
+                               k_scales=None, v_scales=None, *,
+                               scale=None):
+    """Ragged mixed prefill+decode step: ONE kernel appends and attends
+    every descriptor of a flat token batch.
+
+    q:            [T, H, D] flat query rows (decode slots and prefill
+                  chunks packed back to back; T is the engine's static
+                  token capacity).
+    k_new/v_new:  [T, KVH, D] the rows to append, same flat layout.
+    q_start/q_len/kv_len: [S] int32 descriptors — descriptor s covers
+                  flat rows [q_start, q_start + q_len) at context
+                  length kv_len (its rows land at positions
+                  kv_len … kv_len + q_len - 1, all inside page
+                  kv_len // P: callers chunk at page boundaries so
+                  ``kv_len % P + q_len <= P``).  ``q_len == 0`` marks
+                  an unused descriptor slot.
+    page_tables:  [S, maxp] int32 per-descriptor page tables.
+    k_scales/v_scales: optional [KVH, n_pages, 1, P] f32 — int8 pools.
+
+    Returns (out [S, P, H, D], k_pages', v_pages'[, k_scales',
+    v_scales']): descriptor s's row j lives at out[s, j] — the caller
+    gathers flat rows with its (descriptor, offset) map.  Pools are
+    donated/aliased; the only KV writes are one modified page per
+    (descriptor, kv-head)."""
+    t, h, d = q.shape
+    kvh, n_pages, page_size, _ = k_pages.shape
+    s_max = q_start.shape[0]
+    maxp = page_tables.shape[1]
+    g = h // kvh
+    P = page_size
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    quantized = k_scales is not None
+
+    pad = ((P, P), (0, 0), (0, 0), (0, 0))
+    qp = jnp.pad(q.reshape(t, kvh, g, d), pad)
+    knp = jnp.pad(k_new.astype(jnp.float32 if quantized
+                               else k_pages.dtype)[:, :, None, :],
+                  pad)[:, :, 0]
+    vnp = jnp.pad(v_new.astype(jnp.float32 if quantized
+                               else v_pages.dtype)[:, :, None, :],
+                  pad)[:, :, 0]
+
+    kernel = functools.partial(_ragged_kernel, scale=scale,
+                               page_size=P, maxp=maxp,
+                               quantized=quantized)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.ANY),   # q (manual row DMA)
+        pl.BlockSpec(memory_space=pltpu.ANY),   # k_new
+        pl.BlockSpec(memory_space=pltpu.ANY),   # v_new
+        pl.BlockSpec(memory_space=pltpu.ANY),   # k_pages
+        pl.BlockSpec(memory_space=pltpu.ANY),   # v_pages
+    ]
+    out_specs = [
+        pl.BlockSpec((1, P, 1, g, d),
+                     lambda s_, h_, qs, ql, kl, pt: (s_, 0, h_, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((P, g, d), q.dtype),
+        pltpu.VMEM((P, d), knp.dtype),
+        pltpu.VMEM((P, d), vnp.dtype),
+        pltpu.VMEM((_NBUF, P, d), k_pages.dtype),
+        pltpu.VMEM((_NBUF, P, d), v_pages.dtype),
+        pltpu.VMEM((2, P, d), k_pages.dtype),
+        pltpu.SemaphoreType.DMA((3,)),
+        pltpu.SemaphoreType.DMA((_NBUF, 4 if quantized else 2)),
+        pltpu.SemaphoreType.DMA((4 if quantized else 2,)),
+    ]
+    operands = [qp, knp, vnp, k_pages, v_pages]
+    out_shape = [
+        out_sds((s_max, P, kvh, g, d), q.dtype, qp, k_pages, v_pages),
+        out_sds(k_pages.shape, k_pages.dtype, qp, k_pages, v_pages),
+        out_sds(v_pages.shape, v_pages.dtype, qp, k_pages, v_pages),
+    ]
+    # alias indices count the 4 scalar-prefetch operands first
+    aliases = {7: 1, 8: 2}
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                     pl.BlockSpec(memory_space=pltpu.ANY)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                      pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch += [pltpu.VMEM((_NBUF, 1, P), jnp.float32),
+                    pltpu.VMEM((_NBUF, 1, P), jnp.float32),
+                    pltpu.VMEM((2, 1, P), jnp.float32)]
+        operands += [k_scales, v_scales]
+        out_shape += [
+            out_sds(k_scales.shape, k_scales.dtype, qp, k_scales),
+            out_sds(v_scales.shape, v_scales.dtype, qp, v_scales),
+        ]
+        aliases = {7: 1, 8: 2, 9: 3, 10: 4}
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(s_max, kvh),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+        ),
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+    )(q_start.astype(jnp.int32), q_len.astype(jnp.int32),
+      kv_len.astype(jnp.int32), page_tables.astype(jnp.int32),
+      *operands)
+    if quantized:
+        out, kp, vp, ks, vs = outs
+        return out.reshape(s_max, P, h, d), kp, vp, ks, vs
+    out, kp, vp = outs
+    return out.reshape(s_max, P, h, d), kp, vp
+
+
+def paged_write_rows(k_pages, v_pages, k_new, v_new, positions,
+                     row_tables):
+    """Per-ROW pool append: flat row i lands at logical position
+    ``positions[i]`` of its own sequence (page
+    ``row_tables[i, pos // P]``, slot ``pos % P``).  The ragged
+    generalization of ``paged_write`` — T chained dus (statically
+    unrolled), decode rows and prefill-chunk rows alike.  Padding rows
+    point at all-zero tables and position 0, landing in the reserved
+    pad page."""
+    page_size = k_pages.shape[2]
+    t = k_new.shape[0]
+    kt = jnp.swapaxes(k_new, 0, 1).astype(k_pages.dtype)    # [KVH, T, D]
+    vt = jnp.swapaxes(v_new, 0, 1).astype(v_pages.dtype)
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(t):
+        page = row_tables[i, positions[i] // page_size]
+        slot = positions[i] % page_size
+        idx = (zero, page, slot, zero)
+        k_pages = jax.lax.dynamic_update_slice(
+            k_pages, kt[:, i][:, None, None, :], idx)
+        v_pages = jax.lax.dynamic_update_slice(
+            v_pages, vt[:, i][:, None, None, :], idx)
+    return k_pages, v_pages
+
+
+def paged_write_rows_quant(k_pages, v_pages, k_scales, v_scales,
+                           k_new, v_new, positions, row_tables):
+    """INT8 ``paged_write_rows``: per-token absmax quantize on the way
+    in, scale pools [KVH, n_pages, 1, P] updated alongside."""
+    page_size = k_pages.shape[2]
+    t = k_new.shape[0]
+    kq, ks = quantize_rows_raw(k_new)        # [T, KVH, D] i8, [T, KVH]
+    vq, vs = quantize_rows_raw(v_new)
+    kt = jnp.swapaxes(kq, 0, 1)                             # [KVH, T, D]
+    vt = jnp.swapaxes(vq, 0, 1)
+    kst = jnp.swapaxes(ks, 0, 1).astype(k_scales.dtype)     # [KVH, T]
+    vst = jnp.swapaxes(vs, 0, 1).astype(v_scales.dtype)
+    zero = jnp.zeros((), jnp.int32)
+    for i in range(t):
+        page = row_tables[i, positions[i] // page_size]
+        slot = positions[i] % page_size
+        idx = (zero, page, slot, zero)
+        k_pages = jax.lax.dynamic_update_slice(
+            k_pages, kt[:, i][:, None, None, :], idx)
+        v_pages = jax.lax.dynamic_update_slice(
+            v_pages, vt[:, i][:, None, None, :], idx)
+        sidx = (zero, page, zero, slot)
+        k_scales = jax.lax.dynamic_update_slice(
+            k_scales, kst[:, i][:, None, None, None], sidx)
+        v_scales = jax.lax.dynamic_update_slice(
+            v_scales, vst[:, i][:, None, None, None], sidx)
+    return k_pages, v_pages, k_scales, v_scales
+
+
+def ragged_paged_append_attend_reference(q, k_pages, v_pages, k_new,
+                                         v_new, positions, row_tables,
+                                         k_scales=None, v_scales=None):
+    """jnp oracle / CPU path for the ragged mixed step, PER-ROW form:
+    append every flat row at its own position (``paged_write_rows``),
+    then attend each row over its sequence's pages under the mask
+    ``kv_pos <= positions[i]`` — which IS the causal-within-chunk mask
+    (a chunk's rows carry consecutive positions) and degenerates to the
+    decode mask for q_len == 1 rows.  Bit-compatible with both split
+    programs: the decode reference's ``kv_pos < len + 1`` and the
+    chunked prefill's additive ``-1e30`` mask select the same exact
+    logit values, and every other op is row-independent.
+
+    Returns (out [T, H, D], k_pages', v_pages'[, k_scales',
+    v_scales'])."""
+    t, h, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    maxp = row_tables.shape[1]
+    g = h // kvh
+    if k_scales is not None:
+        k_pages, v_pages, k_scales, v_scales = paged_write_rows_quant(
+            k_pages, v_pages, k_scales, v_scales, k_new, v_new,
+            positions, row_tables)
+    else:
+        k_pages, v_pages = paged_write_rows(k_pages, v_pages, k_new,
+                                            v_new, positions,
+                                            row_tables)
+    # [T, KVH, maxp, P, D] -> [T, KVH, S, D]
+    kg = jnp.swapaxes(k_pages[:, row_tables], 0, 1)
+    vg = jnp.swapaxes(v_pages[:, row_tables], 0, 1)
+    if k_scales is not None:
+        ksg = jnp.swapaxes(jnp.swapaxes(k_scales[:, row_tables], 0, 1),
+                           -1, -2)
+        vsg = jnp.swapaxes(jnp.swapaxes(v_scales[:, row_tables], 0, 1),
+                           -1, -2)
+        kg = kg.astype(jnp.float32) * ksg
+        vg = vg.astype(jnp.float32) * vsg
+    s_tot = maxp * page_size
+    kg = kg.reshape(t, kvh, s_tot, d)
+    vg = vg.reshape(t, kvh, s_tot, d)
+    qg = q.reshape(t, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum("tkgd,tksd->tkgs", qg,
+                   kg.astype(jnp.float32)) / (d ** 0.5)
+    mask = jnp.arange(s_tot)[None, :] <= positions[:, None]  # [T, S]
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("tkgs,tksd->tkgd", p, vg.astype(jnp.float32))
+    o = o.reshape(t, h, d).astype(q.dtype)
+    if k_scales is not None:
+        return o, k_pages, v_pages, k_scales, v_scales
+    return o, k_pages, v_pages

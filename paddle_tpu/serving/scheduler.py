@@ -159,7 +159,22 @@ class Scheduler:
     requests do NOT count against ``max_queue`` (they were already
     admitted once; shedding them would discard computed tokens) and
     are never expired by queue timers — only ``cancel`` or their
-    deadline at delivery touches them."""
+    deadline at delivery touches them.
+
+    ``chunked_prefill`` (opt-in, requires an engine built with
+    ``unified_step=True``) admits waiting requests through
+    ``LLMEngine.begin_request`` instead of the synchronous
+    ``add_request``: the prompt's prefill then rides the ragged
+    unified step alongside ongoing decodes, a page-sized chunk per
+    step under the engine's ``prefill_token_budget``, so a long
+    prompt never stalls in-flight decode.  The first token arrives
+    from a later ``step()`` rather than at admission — TTFT
+    bookkeeping moves to token delivery.  ``decode_tpot_slo``
+    (seconds per decode token, None = off) enables an AIMD
+    controller on the engine's runtime ``prefill_token_budget``:
+    when a mixed step's per-token wall time breaches the SLO the
+    budget halves (decode latency wins), otherwise it recovers one
+    page per step up to the configured ceiling."""
 
     def __init__(self, engine, max_queue: int = 64,
                  max_queue_time: Optional[float] = None,
@@ -168,12 +183,20 @@ class Scheduler:
                  preemption: bool = True,
                  max_preemptions_per_request: int = 2,
                  packing: bool = False,
-                 packing_max_overtakes: int = 8):
+                 packing_max_overtakes: int = 8,
+                 chunked_prefill: bool = False,
+                 decode_tpot_slo: Optional[float] = None):
         enforce(max_queue >= 1, "max_queue must be >= 1")
         enforce(max_preemptions_per_request >= 0,
                 "max_preemptions_per_request must be >= 0")
         enforce(packing_max_overtakes >= 1,
                 "packing_max_overtakes must be >= 1")
+        enforce(not chunked_prefill or getattr(engine, "unified_step",
+                                              False),
+                "chunked_prefill requires an engine with "
+                "unified_step=True")
+        enforce(decode_tpot_slo is None or decode_tpot_slo > 0,
+                "decode_tpot_slo must be positive (or None)")
         self.engine = engine
         self.max_queue = max_queue
         self.default_max_queue_time = max_queue_time
@@ -181,6 +204,8 @@ class Scheduler:
         self.max_preemptions_per_request = max_preemptions_per_request
         self.packing = bool(packing)
         self.packing_max_overtakes = packing_max_overtakes
+        self.chunked_prefill = bool(chunked_prefill)
+        self.decode_tpot_slo = decode_tpot_slo
         self._clock = clock or time.monotonic
         self._lock = threading.RLock()
         self._reqs: Dict[object, ScheduledRequest] = {}
@@ -381,10 +406,21 @@ class Scheduler:
             self._expire_waiting(events)
             self._admit(events, out)
             if self.engine.has_work():
-                for rid, toks in self.engine.step().items():
+                t0 = time.perf_counter()
+                step_out = self.engine.step()
+                self._adapt_prefill_budget(time.perf_counter() - t0,
+                                           step_out)
+                for rid, toks in step_out.items():
                     rec = self._reqs.get(rid)
                     if rec is None or rec.state != ACTIVE:
                         continue
+                    if (rec.first_token_t is None and toks
+                            and not rec.tokens):
+                        # chunked admission: the first token arrives
+                        # from a mixed step, not at admit time
+                        rec.first_token_t = self._clock()
+                        rec.timeline.append(("first_token",
+                                             rec.first_token_t))
                     rec.tokens.extend(toks)
                     out.setdefault(rid, []).extend(toks)
                     self._event(events, rec,
@@ -393,6 +429,28 @@ class Scheduler:
             self._retire_done(events)
         self._dispatch(events)
         return out
+
+    def _adapt_prefill_budget(self, dt: float, step_out: dict):
+        """AIMD on the engine's runtime ``prefill_token_budget``
+        (chunked_prefill + decode_tpot_slo only).  ``dt`` is the wall
+        time of one engine step window; divided by the window's token
+        count it approximates decode TPOT — mixed windows are single
+        dispatches (nsteps == 1) so the approximation is exact where
+        the knob matters.  Breach: halve (floor 1 — the engine's own
+        livelock guard still guarantees prefill progress on
+        prefill-only steps).  Under SLO: recover one page per step up
+        to the configured ceiling (``engine._pf_budget_static``)."""
+        if not self.chunked_prefill or self.decode_tpot_slo is None:
+            return
+        eng = self.engine
+        nsteps = max((len(t) for t in step_out.values()), default=1)
+        per_tok = dt / max(1, nsteps)
+        budget = int(eng.prefill_token_budget)
+        if per_tok > self.decode_tpot_slo:
+            eng.prefill_token_budget = max(1, budget // 2)
+        else:
+            eng.prefill_token_budget = min(
+                eng._pf_budget_static, budget + eng.cache.page_size)
 
     def busy(self) -> bool:
         """True while anything is waiting, suspended, active, or
@@ -443,7 +501,8 @@ class Scheduler:
         replica thrashing on preemption must look loaded."""
         with self._lock:
             return (self._n_waiting + self._n_suspended +
-                    len(self.engine._active))
+                    len(self.engine._active) +
+                    len(getattr(self.engine, "_prefilling", ())))
 
     def health(self, timeout: Optional[float] = None) -> dict:
         """Liveness answer the prober consumes — in-process replicas
@@ -555,6 +614,7 @@ class Scheduler:
                            else rec.deadline - now,
                        "trace": rec.trace_ctx,
                        "on_event": rec.on_event}
+                ereq = self.engine.requests.get(rid)
                 if rec.state == WAITING:
                     pkg.update({
                         "admitted": False, "prompt": list(rec.prompt),
@@ -565,6 +625,21 @@ class Scheduler:
                             else rec.max_queue_time
                             - (now - rec.submit_t)})
                     self._n_waiting -= 1
+                elif ereq is not None and not ereq.out:
+                    # chunked admission, prefill not finished: no
+                    # token exists, so there is nothing computed worth
+                    # shipping (``import_request`` rightly refuses an
+                    # empty ``out``).  Drop the engine side and travel
+                    # policy-only — the destination admits it fresh.
+                    if rec.state == SUSPENDED:
+                        self._n_suspended -= 1
+                    self.engine.abort(rid)
+                    self.engine.requests.pop(rid, None)
+                    pkg.update({
+                        "admitted": False, "prompt": list(rec.prompt),
+                        "tokens": [], "max_new": rec.max_new,
+                        "eos": rec.eos, "swap": None,
+                        "max_queue_time_remaining": None})
                 else:
                     with _tracing.span("sched.migrate_out",
                                        ctx=rec.trace_ctx) as sp:
@@ -903,21 +978,30 @@ class Scheduler:
         # (whole-prompt + per-chunk) nest under it, landing the whole
         # admission inside the request's trace
         with _tracing.span("sched.admit", ctx=rec.trace_ctx) as sp:
-            eng.add_request(rec.rid, rec.prompt,
-                            max_new_tokens=rec.max_new,
-                            eos_token_id=rec.eos)
+            if self.chunked_prefill:
+                eng.begin_request(rec.rid, rec.prompt,
+                                  max_new_tokens=rec.max_new,
+                                  eos_token_id=rec.eos)
+            else:
+                eng.add_request(rec.rid, rec.prompt,
+                                max_new_tokens=rec.max_new,
+                                eos_token_id=rec.eos)
             sp.set_attr("rid", str(rec.rid))
             sp.set_attr("sched", self.sched_id)
             sp.set_attr("prompt_tokens", len(rec.prompt))
         rec.state = ACTIVE
         rec.admit_t = now
-        rec.first_token_t = self._clock()   # admission's prefill token
         rec.timeline.append(("admitted", now))
-        rec.timeline.append(("first_token", rec.first_token_t))
         self._n_waiting -= 1
         if self._metrics is not None:
             self._metrics["queue_wait"].observe(now - rec.submit_t)
             self._metrics["admitted"].inc()
+        if self.chunked_prefill:
+            # prefill rides subsequent mixed steps — no token exists
+            # yet; step()'s merge loop stamps first_token on delivery
+            return
+        rec.first_token_t = self._clock()   # admission's prefill token
+        rec.timeline.append(("first_token", rec.first_token_t))
         first = list(eng.requests[rec.rid].out)
         rec.tokens.extend(first)
         out.setdefault(rec.rid, []).extend(first)

@@ -379,6 +379,15 @@ class HTTPFrontend:
             if "replicas" in snap else None,
             "kv_page_utilization": eng.get("kv_page_utilization"),
             "active_requests": eng.get("active_requests"),
+            "prefilling_requests": eng.get("prefilling_requests"),
+            # ragged unified step: the last mixed batch's decode/
+            # prefill split (interleave ratio = prefill / (prefill +
+            # decode)) and the one-program invariant gauge
+            "mixed_batch_decode_slots":
+                eng.get("mixed_batch_decode_slots"),
+            "mixed_batch_prefill_tokens":
+                eng.get("mixed_batch_prefill_tokens"),
+            "mixed_compiles": eng.get("mixed_compiles"),
             "ttft_seconds": {
                 k: eng["ttft_seconds"][k]
                 for k in ("count", "mean", "p50", "p95", "p99")
