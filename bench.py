@@ -992,6 +992,161 @@ def bench_trace():
                       "budget": "overhead <= 3%"}}
 
 
+def bench_fleet_health():
+    """Fleet-health-plane overhead row (ISSUE 14): decode tokens/sec
+    through the SAME scheduler-driven workload with the health plane
+    off vs on.  Health-off is a strict no-op (one module-global read
+    returning NULL_HEALTH — the budget-guard test pins it); health ON
+    adds two SlidingWindow observes per TTFT / decode WINDOW (never
+    per token), so the acceptance bar is <=3% throughput overhead,
+    with tokens bit-identical and the compile counts unchanged.  Also
+    runs a chaos-interrupted ``fit`` (stop mid-epoch, then
+    auto_resume) and reports the GoodputMeter's fractions — they sum
+    to 1.0 by construction and restart_replay is nonzero only in the
+    resumed run."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import health as obs_health
+    from paddle_tpu.serving import FleetWatcher, ReplicaRouter, Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 256, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 4, 96, 8, 128, 4
+        prompts = [8, 5, 12, 9]
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if not on_tpu:
+        dtype = np.float32
+
+    def run(enable):
+        # both arms ride the SAME path (scheduler behind a one-replica
+        # router); the ON arm additionally enables the health plane AND
+        # runs a live FleetWatcher thread scraping fleet_snapshot()
+        # concurrently — the realistic always-on cost
+        if enable:
+            obs_health.enable_health()
+        else:
+            obs_health.disable_health()
+        watcher = None
+        try:
+            rng = np.random.default_rng(0)
+            eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                            page_size=page, dtype=dtype,
+                            steps_per_sync=sync)
+            sched = Scheduler(eng)
+            router = ReplicaRouter([sched], sleep=lambda s: None)
+            if enable:
+                watcher = FleetWatcher(router, interval=0.02)
+                watcher.start()
+            for i, plen in enumerate(prompts):
+                router.submit(
+                    f"h{i}",
+                    rng.integers(1, cfg.vocab_size, plen).tolist(),
+                    max_new_tokens=new)
+            sched.step()               # warmup: compiles the window
+            produced0 = sum(len(r.out)
+                            for r in eng.requests.values())
+            t0 = time.perf_counter()
+            sched.run_until_idle()
+            dt = time.perf_counter() - t0
+            total = sum(
+                len(sched.result(f"h{i}"))
+                for i in range(len(prompts))) - produced0
+            return total / dt, eng
+        finally:
+            if watcher is not None:
+                watcher.stop()
+            obs_health.disable_health()
+
+    run(False)                         # shared compile + cache warmup
+    off, on = [], []
+    eng_on = None
+    for _ in range(5):                 # interleaved best-of (clock
+        off.append(run(False)[0])      # drift hits both arms equally)
+        rate, eng_on = run(True)
+        on.append(rate)
+    best_off, best_on = max(off), max(on)
+    overhead = (best_off - best_on) / best_off
+    compiles = eng_on.prefill_compiles()
+
+    # -- goodput/badput accounting under an injected mid-run kill ------
+    import shutil
+    import tempfile
+
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.io.dataloader import CheckpointableLoader, Dataset
+
+    class _Arr(Dataset):
+        def __init__(self, n=32):
+            r = np.random.default_rng(23)
+            self.x = r.normal(size=(n, 6)).astype(np.float32)
+            self.y = r.normal(size=(n, 3)).astype(np.float32)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    class _StopAfter(Callback):
+        def __init__(self, n):
+            super().__init__()
+            self.n, self.seen = n, 0
+
+        def on_train_batch_end(self, step, logs=None):
+            self.seen += 1
+            if self.seen >= self.n:
+                self.model.stop_training = True
+
+    def _fit(seed, ckdir, **kw):
+        paddle.seed(seed)
+        m = paddle.Model(nn.Sequential(
+            nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3)))
+        m.prepare(optimizer.AdamW(learning_rate=5e-3), nn.MSELoss())
+        loader = CheckpointableLoader(_Arr(), batch_size=4,
+                                      shuffle=True, seed=7)
+        m.fit(loader, epochs=2, verbose=0, checkpoint_dir=ckdir,
+              save_steps=3, **kw)
+        return obs_health.get_health().goodput.report()
+
+    ckdir = tempfile.mkdtemp(prefix="bench-fleet-health-")
+    try:
+        obs_health.enable_health()
+        _fit(1, ckdir, callbacks=[_StopAfter(5)])   # injected kill
+        rep = _fit(9, ckdir, auto_resume=True)      # "fresh process"
+    finally:
+        obs_health.disable_health()
+        shutil.rmtree(ckdir, ignore_errors=True)
+    frac = rep["fractions"]
+
+    return {"metric": "llama_serving_health_overhead_pct",
+            "unit": "percent", "value": round(overhead * 100, 2),
+            "extra": {"device_kind": kind,
+                      "tokens_per_sec_health_off": round(best_off, 1),
+                      "tokens_per_sec_health_on": round(best_on, 1),
+                      "prefill_compiles": compiles,
+                      "goodput_fraction": round(rep["goodput"], 4),
+                      "fractions": {k: round(v, 4)
+                                    for k, v in sorted(frac.items())},
+                      "fractions_sum": round(sum(frac.values()), 6),
+                      "restart_replay_seconds": round(
+                          rep["seconds"]["restart_replay"], 4),
+                      "budget": "overhead <= 3%"}}
+
+
 def bench_serving_prefix():
     """Automatic-prefix-caching row (ISSUE 3): N requests sharing a
     long system prompt, admitted through the SAME engine workload with
@@ -1777,6 +1932,7 @@ def main():
                ("bench_serving_quant", bench_serving_quant),
                ("bench_serving_metrics", bench_serving_metrics),
                ("bench_trace", bench_trace),
+               ("bench_fleet_health", bench_fleet_health),
                ("bench_serving_prefix", bench_serving_prefix),
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),

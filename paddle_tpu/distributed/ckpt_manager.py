@@ -108,8 +108,10 @@ class CheckpointManager:
         returns (training may mutate/donate immediately) and disk writes
         happen on a bounded background queue.  Raises a previous
         background save's failure before starting a new one."""
+        from ..observability import health as _health
         from ..observability import tracing as _tracing
-        with self._lock, _tracing.span(
+        with self._lock, _health.goodput_region(
+                "checkpoint_save"), _tracing.span(
                 "train.checkpoint_save",
                 attrs={"step": step,
                        "mode": "async" if self.async_save

@@ -154,15 +154,20 @@ class ShardedTrainStep(CompiledTrainStep):
         # same tracing + StepTimer contract as the parent: one span
         # per step, fence on the sharded outputs so multi-chip async
         # dispatch can't flatter step time
+        from ..observability import health as _health
         from ..observability import tracing as _tracing
         span = _tracing.span("train.compiled_step")
         span.set_attr("step", self._step_count)
         span.set_attr("sharded", True)
-        if self._timer is not None:
-            self._timer.start()
-        self.state, loss = self._step_fn(self.state, batch, sub, lr)
-        if self._timer is not None:
-            self._timer.stop(fence=(self.state, loss))
+        with _health.goodput_region(
+                "productive_step" if self._compiled_once
+                else "compile"):
+            if self._timer is not None:
+                self._timer.start()
+            self.state, loss = self._step_fn(self.state, batch, sub, lr)
+            if self._timer is not None:
+                self._timer.stop(fence=(self.state, loss))
+        self._compiled_once = True
         span.end()
         # same resumable-state contract as the parent: the update count
         # must tick here too or a sharded run's checkpoint lies about

@@ -265,12 +265,19 @@ class Model:
         last_saved = -1
         loader_ckptable = hasattr(loader, "state_dict") and \
             hasattr(loader, "set_state_dict")
+        # goodput accounting: one window per fit() call — a resumed
+        # run books its checkpoint restore as restart_replay (the
+        # badput a kill actually cost), a fresh run shows zero there
+        from ..observability import health as _health
+        _health.get_health().goodput.start()
         if checkpoint_dir is not None:
             from ..distributed.ckpt_manager import CheckpointManager
             manager = checkpoint_dir if isinstance(
                 checkpoint_dir, CheckpointManager) else \
                 CheckpointManager(str(checkpoint_dir))
             if auto_resume:
+                import time as _time
+                _t0 = _time.monotonic()
                 restored = manager.restore(step)
                 if restored is not None:
                     rstep, extra = restored
@@ -280,6 +287,10 @@ class Model:
                     lstate = extra.get("loader")
                     if lstate is not None and loader_ckptable:
                         loader.set_state_dict(lstate)
+                    # booked only when a checkpoint actually replayed:
+                    # a fresh run's no-op restore probe isn't badput
+                    _health.get_health().goodput.add(
+                        "restart_replay", _time.monotonic() - _t0)
 
         def ckpt_extra(epoch):
             lstate = loader.state_dict() if loader_ckptable else None
@@ -317,7 +328,8 @@ class Model:
             # is off, so the loop shape costs nothing
             it = iter(ldr)
             while True:
-                with _tracing.span("train.data_load"):
+                with _tracing.span("train.data_load"), \
+                        _health.goodput_region("data_stall"):
                     try:
                         batch = next(it)
                     except StopIteration:
@@ -345,6 +357,15 @@ class Model:
                     timer.tokens_per_step = int(
                         np.prod(np.shape(ins[0]))) or None
                 logs = {"loss": self.train_batch(ins, labs)[0]}
+                # anomaly sentinel: NaN/Inf or an EWMA spike in the
+                # step loss trips the configured policy (warn /
+                # skip_step / halt) and dumps the flight recorder
+                _act = _health.get_health().sentinel_check(
+                    step=global_step,
+                    loss=float(np.asarray(logs["loss"]).ravel()[0]))
+                if _act == "halt":
+                    self.stop_training = True
+                _skip_metrics = _act == "skip_step"
                 if timer.flops_per_step is None and \
                         timer.peak_flops is not None:
                     # first step compiled the program: one AOT lowering
@@ -355,7 +376,7 @@ class Model:
                          "labels": tuple(_as_list(labs))})
                     if timer.flops_per_step is None:
                         timer.peak_flops = None   # don't retry per step
-                if self._metrics:
+                if self._metrics and not _skip_metrics:
                     preds = self._last_train_preds
                     self._last_train_preds = None  # consume: don't pin
                     if preds is not None:
@@ -402,6 +423,7 @@ class Model:
                     cur_epoch if self.stop_training else epochs))
             manager.wait()
         cbks.on_train_end(logs)
+        _health.get_health().goodput.stop()
         # the VisualDL callback closed its writer above — detach the
         # timer so later direct train_batch calls can't write into it
         step.attach_timer(None)

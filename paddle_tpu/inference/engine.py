@@ -77,6 +77,7 @@ import numpy as np
 
 from ..common.errors import enforce
 from ..observability import get_registry
+from ..observability import health as _health
 from ..observability import tracing as _tracing
 from ..profiler import RecordEvent
 from .paged_cache import PagedKVCache
@@ -943,10 +944,12 @@ class LLMEngine:
         st["miss_tokens"] += plen - cached
         st["shared_pages"] += len(shared_pages)
         st["hit_requests" if cached else "miss_requests"] += 1
+        # the int() above synced the device: TTFT is honest
+        ttft = time.perf_counter() - t_admit
+        _health.get_health().observe_ttft(ttft)
         if self._metrics is not None:
             m = self._metrics
-            # the int() above synced the device: TTFT is honest
-            m["ttft"].observe(time.perf_counter() - t_admit)
+            m["ttft"].observe(ttft)
             m["prompt_tokens"].inc(plen)
             m["generated_tokens"].inc(1)
             m["requests"].inc()
@@ -1121,6 +1124,7 @@ class LLMEngine:
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
+        _health.get_health().observe_tpot(dt_win / nsteps, n=nsteps)
         if self._metrics is not None:
             m = self._metrics
             # ONE weighted observe per window: value is the wall time a
@@ -1303,15 +1307,18 @@ class LLMEngine:
             req.out.append(first)
             self._prefilling.remove(req)
             out[req.rid] = [first]
-            if self._metrics is not None and req.t_submit is not None:
-                self._metrics["ttft"].observe(
-                    time.perf_counter() - req.t_submit)
+            if req.t_submit is not None:
+                ttft = time.perf_counter() - req.t_submit
+                _health.get_health().observe_ttft(ttft)
+                if self._metrics is not None:
+                    self._metrics["ttft"].observe(ttft)
             if (req.eos is not None and first == req.eos) or \
                     req.max_new <= 1:
                 req.done = True
                 self.cache.release(req.slot)
             else:
                 self._active.append(req)
+        _health.get_health().observe_tpot(dt_win / nsteps, n=nsteps)
         if self._metrics is not None:
             m = self._metrics
             m["tpot"].observe(dt_win / nsteps, n=nsteps)

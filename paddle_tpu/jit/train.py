@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..autograd import tape
 from ..nn.layer import Layer, functional_state
+from ..observability import health as _health
 from ..observability import tracing as _tracing
 from ..ops import random as _random
 from ..optimizer.optimizer import Optimizer
@@ -105,6 +106,9 @@ class CompiledTrainStep:
         # optimizer-update count (fused __call__ + apply_grads); part of
         # the resumable state so a restored run knows where it is
         self._step_count = 0
+        # first dispatch pays the jit trace+compile: the goodput meter
+        # books it as "compile", every later step as "productive_step"
+        self._compiled_once = False
 
     # -- telemetry -----------------------------------------------------------
     def attach_timer(self, timer):
@@ -231,12 +235,16 @@ class CompiledTrainStep:
         # device-inclusive); the shared NULL_SPAN when tracing is off
         span = _tracing.span("train.compiled_step")
         span.set_attr("step", self._step_count)
-        if self._timer is not None:
-            self._timer.start()
-        self.state, out = self._step_fn(self.state, _to_arrays(batch), sub,
-                                        lr)
-        if self._timer is not None:
-            self._timer.stop(fence=(self.state, out))
+        with _health.goodput_region(
+                "productive_step" if self._compiled_once
+                else "compile"):
+            if self._timer is not None:
+                self._timer.start()
+            self.state, out = self._step_fn(self.state,
+                                            _to_arrays(batch), sub, lr)
+            if self._timer is not None:
+                self._timer.stop(fence=(self.state, out))
+        self._compiled_once = True
         span.end()
         self._step_count += 1
         sched = self.optimizer._lr_scheduler

@@ -17,6 +17,13 @@ Three pieces, all stdlib-only:
   dumped to JSONL on SIGTERM / fatal / wedge).  Disabled tracing is a
   strict hot-path no-op: instrumentation sites read one module global
   and get the shared ``NULL_SPAN`` singleton back.
+- :mod:`~paddle_tpu.observability.health` — the fleet health plane:
+  ``SlidingWindow`` time-bucketed views, ``SLOTracker`` multi-window
+  burn rates, ``GoodputMeter`` training wall-time accounting,
+  ``AnomalySentinel`` loss/grad-norm watchdogs, and the histogram
+  merge helpers ``ReplicaRouter.fleet_snapshot()`` federates with.
+  Same disabled-is-free contract: ``get_health()`` returns the shared
+  ``NULL_HEALTH`` singleton when the plane is off.
 
 Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
 gauges, compile-count gauges) lives with the instrumented code in
@@ -37,6 +44,11 @@ from .steptimer import StepTimer, device_peak_flops
 from .tracing import (FlightRecorder, Span, Tracer, disable_tracing,
                       enable_flight_recorder, enable_tracing,
                       get_flight_recorder, get_tracer)
+from .health import (SLO, AnomalySentinel, GoodputMeter, HealthHub,
+                     SlidingWindow, SLOTracker, disable_health,
+                     enable_health, get_health, goodput_region,
+                     merge_histogram_snapshots)
+from . import health
 from . import tracing
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
@@ -44,4 +56,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
            "MetricsServer", "start_metrics_server", "StepTimer",
            "device_peak_flops", "Span", "Tracer", "FlightRecorder",
            "enable_tracing", "disable_tracing", "get_tracer",
-           "enable_flight_recorder", "get_flight_recorder", "tracing"]
+           "enable_flight_recorder", "get_flight_recorder", "tracing",
+           "SlidingWindow", "SLO", "SLOTracker", "GoodputMeter",
+           "AnomalySentinel", "HealthHub", "enable_health",
+           "disable_health", "get_health", "goodput_region",
+           "merge_histogram_snapshots", "health"]

@@ -242,18 +242,20 @@ class Histogram(_Metric):
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
-    def quantile(self, q: float) -> float:
+    def quantile(self, q: float) -> Optional[float]:
         """Estimated q-quantile (0..1) by linear interpolation inside
         the bucket holding the q-th observation (Prometheus
         ``histogram_quantile`` semantics).  Observations past the last
         finite bucket clamp to that bound — a fixed-bucket histogram
-        cannot resolve its own overflow tail.  0.0 when empty."""
+        cannot resolve its own overflow tail.  ``None`` when empty
+        (rendered ``n/a`` by /statusz): an empty histogram has no
+        percentile, and 0.0 reads as "instant" on a latency family."""
         enforce(0.0 <= q <= 1.0, f"quantile {q} outside [0, 1]")
         with self._lock:
             total = self._count
             counts = list(self._counts)
         if total == 0:
-            return 0.0
+            return None
         rank = q * total
         cum = 0
         for i, c in enumerate(counts):

@@ -34,7 +34,14 @@ Layers, composable bottom-up:
   ``POST /v1/completions`` (chunked per-step token streaming), the
   ``/v1/*`` control plane the remote transport drives,
   ``GET /healthz`` (503 when draining/wedged), ``GET /metrics``
-  (Prometheus text via the observability registry).
+  (Prometheus text via the observability registry), and
+  ``GET /fleetz`` (the federated fleet health page built from
+  ``ReplicaRouter.fleet_snapshot()``).
+* ``FleetWatcher`` (serving/autopilot.py) — the rebalancing policy
+  loop: reads burn rates and load skew from ``fleet_snapshot()`` and
+  acts through the router's own actuators (``mark_slow`` /
+  ``drain_replica`` / ``reinstate``) with hysteresis and a bounded
+  action rate.
 
 All layers report through the process-global ``MetricRegistry``
 (queue-wait histogram, shed/abort/deadline-miss/retry counters,
@@ -48,8 +55,10 @@ from .server import HTTPFrontend, start_http_frontend
 from .transport import (HealthProber, RemoteReplica, TransportError,
                         TransportTimeout)
 from .faults import Fault, FaultInjected, FaultPlan
+from .autopilot import FleetWatcher
 
 __all__ = ["Scheduler", "ScheduledRequest", "RejectedError",
            "ReplicaRouter", "HTTPFrontend", "start_http_frontend",
            "RemoteReplica", "HealthProber", "TransportError",
-           "TransportTimeout", "Fault", "FaultPlan", "FaultInjected"]
+           "TransportTimeout", "Fault", "FaultPlan", "FaultInjected",
+           "FleetWatcher"]

@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import health as _health
 from ..observability import tracing as _tracing
 from ..observability.tracing import record_event
 from .scheduler import RejectedError
@@ -630,3 +631,83 @@ class ReplicaRouter:
             return replica.metrics_snapshot()
         except Exception as e:
             return {"error": str(e)}
+
+    # -- federation ------------------------------------------------------------
+    _FLEET_COUNTERS = ("admitted", "completed", "aborted",
+                       "deadline_miss", "preempted", "migrated_out",
+                       "migrated_in")
+    _FLEET_ENGINE_COUNTERS = ("prompt_tokens", "generated_tokens",
+                              "requests")
+    _FLEET_HISTOGRAMS = (("ttft_seconds", "engine"),
+                         ("tpot_seconds", "engine"),
+                         ("queue_wait_seconds", "sched"))
+
+    def fleet_snapshot(self) -> dict:
+        """The federated fleet view behind ``GET /fleetz``: one scrape
+        per live replica (``fleet_scrape()`` for remote backends — a
+        single short-timeout ``/v1/metrics_snapshot`` round trip — or
+        ``metrics_snapshot()`` in-process), merged into fleet-wide
+        counters and bucket-wise-merged latency histograms, plus each
+        replica's circuit/load/KV/SLO state.  A replica that fails its
+        scrape is marked ``stale`` (last resort: an unreachable
+        replica must not take the whole fleet view down), and ejected
+        replicas are never scraped — they are dead to the router."""
+        # router state under the lock; the scrapes (network round
+        # trips for remote replicas) outside it — a slow replica must
+        # not stall submit()/step() for its timeout
+        with self._lock:
+            n = len(self.replicas)
+            rows = [{
+                "replica": i,
+                "ejected": i in self._ejected,
+                "healthy": self._healthy(i),
+                "consecutive_failures":
+                    self._state[i].consecutive_failures,
+                "failures_total": self._state[i].failures_total,
+                "requests_total": self._state[i].requests_total,
+                "circuit_open_until": self._state[i].open_until,
+                "load": None, "stale": False, "metrics": None,
+            } for i in range(len(self.replicas))]
+        for row, replica in zip(rows, self.replicas):
+            if row["ejected"]:
+                row["stale"] = True           # nothing fresh, by design
+                continue
+            row["load"] = self._load(row["replica"])
+            try:
+                scrape = replica.fleet_scrape() \
+                    if hasattr(replica, "fleet_scrape") \
+                    else replica.metrics_snapshot()
+                enforce(isinstance(scrape, dict),
+                        "scrape must be a dict")
+                row["metrics"] = scrape
+            except Exception as e:
+                row["stale"] = True
+                row["error"] = str(e)
+        for row in rows:
+            snap = row["metrics"] or {}
+            eng = snap.get("engine") or {}
+            row["kv_page_utilization"] = eng.get("kv_page_utilization")
+            row["slo"] = (snap.get("health") or {}).get("slo")
+        fresh = [r["metrics"] for r in rows if r["metrics"]]
+        fleet = {"replicas": n, "scraped": len(fresh),
+                 "stale": sum(1 for r in rows if r["stale"])}
+        for key in self._FLEET_COUNTERS:
+            fleet[key] = sum(s.get(key, 0) or 0 for s in fresh)
+        fleet["shed"] = sum((s.get("shed") or {}).get("total", 0)
+                            for s in fresh)
+        for key in self._FLEET_ENGINE_COUNTERS:
+            fleet[key] = sum((s.get("engine") or {}).get(key, 0) or 0
+                             for s in fresh)
+        for name, where in self._FLEET_HISTOGRAMS:
+            parts = [(s.get("engine") or {}).get(name) if where ==
+                     "engine" else s.get(name) for s in fresh]
+            merged = _health.merge_histogram_snapshots(parts)
+            if merged is not None:
+                fleet[name] = merged
+        out = {"router": self.router_id, "retries": self.retry_count,
+               "ejected": sorted(self._ejected),
+               "replicas": rows, "fleet": fleet}
+        h = _health.get_health()
+        if h.enabled:
+            out["health"] = h.snapshot()
+        return out

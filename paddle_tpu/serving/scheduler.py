@@ -69,6 +69,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import health as _health
 from ..observability import tracing as _tracing
 
 __all__ = ["Scheduler", "RejectedError", "ScheduledRequest"]
@@ -286,6 +287,7 @@ class Scheduler:
 
     def _shed_inc(self, reason: str):
         self.shed_stats[reason] = self.shed_stats.get(reason, 0) + 1
+        _health.get_health().event("shed_rate", bad=True)
         if self._metrics is not None:
             self._metrics["shed"].labels(self.sched_id, reason).inc()
 
@@ -359,6 +361,9 @@ class Scheduler:
             rec.timeline.append(("submitted", now))
             self._trace_enqueue(rec, trace_ctx)
             self._set_waiting_gauge()
+        # shed-rate SLO sees every submission outcome: good here, bad
+        # at each _shed_inc site
+        _health.get_health().event("shed_rate", bad=False)
         return rid
 
     def cancel(self, rid) -> bool:
@@ -813,6 +818,13 @@ class Scheduler:
                     "queue_wait_seconds":
                         m["queue_wait"]._snapshot_value(),
                 })
+        # windowed health view rides along so every /v1/stats or
+        # /v1/metrics_snapshot scrape carries burn rates (the hub is
+        # process-global: in-process replicas share one hub, remote
+        # replicas each publish their own)
+        h = _health.get_health()
+        if h.enabled:
+            snap["health"] = h.snapshot()
         return snap
 
     # -- internals (lock held) -------------------------------------------------
@@ -1090,6 +1102,7 @@ class Scheduler:
                     self._metrics["deadline_miss"].inc()
             if self._metrics is not None:
                 self._metrics["completed"].inc()
+            _health.get_health().event("error_rate", bad=False)
             self._event(events, rec,
                         {"type": "finished", "rid": rid,
                          "tokens": list(rec.tokens),

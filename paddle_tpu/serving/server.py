@@ -72,6 +72,7 @@ from typing import Optional
 
 from ..common.errors import EnforceError, UnavailableError
 from ..observability import get_registry
+from ..observability import health as _health
 from ..observability import tracing as _tracing
 from ..observability.exposition import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from .scheduler import RejectedError
@@ -179,6 +180,14 @@ class HTTPFrontend:
                 elif path == "/v1/stats":
                     frontend._guarded(
                         self, frontend.target.metrics_snapshot)
+                elif path == "/v1/metrics_snapshot":
+                    # the federation scrape verb: same payload as
+                    # /v1/stats today, but a dedicated route so the
+                    # fleet plane can version it independently
+                    frontend._guarded(
+                        self, frontend.target.metrics_snapshot)
+                elif path == "/fleetz":
+                    frontend._guarded(self, frontend._fleetz)
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
@@ -388,11 +397,7 @@ class HTTPFrontend:
             "mixed_batch_prefill_tokens":
                 eng.get("mixed_batch_prefill_tokens"),
             "mixed_compiles": eng.get("mixed_compiles"),
-            "ttft_seconds": {
-                k: eng["ttft_seconds"][k]
-                for k in ("count", "mean", "p50", "p95", "p99")
-                if k in eng.get("ttft_seconds", {})}
-            if isinstance(eng.get("ttft_seconds"), dict) else None,
+            "ttft_seconds": self._ttft_view(eng),
         }
         tr = _tracing.get_tracer()
         out["tracing"] = {"enabled": tr is not None and tr.enabled,
@@ -403,6 +408,62 @@ class HTTPFrontend:
         rec = _tracing.get_flight_recorder()
         out["recent_errors"] = rec.recent_errors() \
             if rec is not None else []
+        return out
+
+    @staticmethod
+    def _ttft_view(eng: dict) -> Optional[dict]:
+        """The /statusz TTFT block.  With the health plane on, the
+        percentiles come from the sliding window (what latency looks
+        like NOW) instead of the lifetime histogram a week of uptime
+        has diluted; either way an empty view renders ``"n/a"``, not
+        a 0.0 that reads as "instant"."""
+        h = _health.get_health()
+        if h.enabled:
+            win = h.snapshot()["windows"]["ttft"]
+            view = {k: win.get(k) for k in
+                    ("count", "mean", "p50", "p95", "p99")}
+            view["window_seconds"] = win["window_seconds"]
+        elif isinstance(eng.get("ttft_seconds"), dict):
+            view = {k: eng["ttft_seconds"][k]
+                    for k in ("count", "mean", "p50", "p95", "p99")
+                    if k in eng["ttft_seconds"]}
+        else:
+            return None
+        return {k: ("n/a" if v is None else v)
+                for k, v in view.items()}
+
+    def _fleetz(self) -> dict:
+        """The federated fleet page: per-replica circuit/load/KV/SLO
+        state plus merged fleet-wide counters and histograms.  Router
+        targets answer from ``fleet_snapshot()``; a single-replica
+        target is presented as a fleet of one so operators can point
+        dashboards at any tier."""
+        target = self.target
+        if hasattr(target, "fleet_snapshot"):
+            return target.fleet_snapshot()
+        try:
+            snap = target.metrics_snapshot()
+            stale, err = False, None
+        except Exception as e:
+            snap, stale, err = None, True, str(e)
+        eng = (snap or {}).get("engine") or {}
+        row = {"replica": 0, "ejected": False, "healthy": not stale,
+               "load": None, "stale": stale, "metrics": snap,
+               "kv_page_utilization": eng.get("kv_page_utilization"),
+               "slo": ((snap or {}).get("health") or {}).get("slo")}
+        if err is not None:
+            row["error"] = err
+        try:
+            row["load"] = target.load()
+        except Exception:
+            pass
+        out = {"router": None, "replicas": [row],
+               "fleet": {"replicas": 1,
+                         "scraped": 0 if stale else 1,
+                         "stale": 1 if stale else 0}}
+        h = _health.get_health()
+        if h.enabled:
+            out["health"] = h.snapshot()
         return out
 
     def _tracez(self, query: str) -> dict:
@@ -598,6 +659,7 @@ class HTTPFrontend:
             _tracing.record_event(
                 "error", where=f"http:{handler.path.split('?')[0]}",
                 error=f"{type(e).__name__}: {e}")
+            _health.get_health().event("error_rate", bad=True)
             handler._json(500, {"error": f"{type(e).__name__}: {e}"})
         else:
             handler._json(200, out if isinstance(out, dict) else {})

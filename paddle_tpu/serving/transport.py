@@ -455,6 +455,14 @@ class RemoteReplica:
     def metrics_snapshot(self) -> dict:
         return self._call("poll", "GET", "/v1/stats")
 
+    def fleet_scrape(self) -> dict:
+        """The federation scrape (``GET /v1/metrics_snapshot``): one
+        round trip, NO retries, a short bound like ``load()``'s — a
+        wedged replica makes the fleet view mark it ``stale``, it must
+        not stall the scrape loop for the full retry ladder."""
+        return self._call("poll", "GET", "/v1/metrics_snapshot",
+                          retries=0, timeout=min(self.timeout, 2.0))
+
     def request_timeline(self, rid) -> dict:
         """The backend's per-request timing breakdown
         (``POST /v1/timeline``) — timestamps are the BACKEND's

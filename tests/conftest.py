@@ -1,8 +1,11 @@
 """tests/ conftest: fleet/mesh state is torn down after every test so
 topology-building tests can't leak meshes into each other; a
 thread-leak guard keeps the serving tier's HTTP servers / probers /
-loop threads — and the checkpoint tier's ``paddle-tpu-ckpt-writer``
-async-save threads — from outliving their test (a leaked loop thread is
+loop threads — the checkpoint tier's ``paddle-tpu-ckpt-writer``
+async-save threads and the autopilot's ``paddle-tpu-watcher`` policy
+loop included (every serving-tier thread carries the ``paddle-tpu-``
+name prefix precisely so this guard sees it) — from outliving their
+test (a leaked loop thread is
 how a tier-1 run hangs on a 1-core box); and a staging-dir guard fails
 any test that leaves ``*.tmp-<nonce>`` checkpoint staging dirs behind
 (an un-swept torn save — call ``CheckpointManager.gc_stale()`` or do a

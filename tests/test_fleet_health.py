@@ -1,0 +1,791 @@
+"""Fleet health plane + autopilot — windowed SLO burn rates, metrics
+federation, goodput accounting, anomaly sentinels, and the
+FleetWatcher policy loop (ISSUE 14).
+
+Contracts under test:
+
+* ``SlidingWindow``: slot rotation expires old observations exactly at
+  the window edge, weighted observes, bucket-interpolated quantiles
+  (``None`` when empty — an empty window is unknown, not instant);
+* ``SLOTracker``: burn rate = bad_fraction / objective, and BURNING
+  requires the fast AND the slow window over threshold (a blip that
+  left the fast window can't page);
+* federation: ``merge_histogram_snapshots`` is bucket-exact against a
+  single-process oracle; ``fleet_snapshot`` sums counters across live
+  replicas, marks a mid-scrape timeout ``stale`` instead of raising,
+  and never scrapes an ejected replica;
+* disabled-is-free: ``get_health()`` / ``goodput_region()`` return the
+  SHARED null singletons (identity-asserted), and the enabled plane
+  changes no tokens and adds no compiles;
+* ``GoodputMeter``: fractions sum to 1.0 by construction; over a
+  chaos-interrupted ``fit`` the restart-replay bucket is nonzero ONLY
+  on the resumed run;
+* ``AnomalySentinel``: NaN trips immediately, EWMA spikes only after
+  warmup, trips land in the flight recorder, and the ``halt`` policy
+  stops ``fit`` cleanly;
+* ``FleetWatcher``: hysteresis (N consecutive ticks) before any
+  action, bounded action rate + per-replica cooldown, drains a skewed
+  replica with NO lost requests and reinstates it after recovery —
+  no flapping.
+
+Everything runs JAX_PLATFORMS=cpu; HTTP rigs are per-test and torn
+down (the conftest thread-leak guard enforces it, and it knows the
+``paddle-tpu-watcher`` thread name).
+"""
+import http.client
+import json
+import math
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.common.errors import EnforceError
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.inference.engine import LLMEngine
+from paddle_tpu.io.dataloader import CheckpointableLoader, Dataset
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.observability import health as H
+from paddle_tpu.observability import tracing as T
+from paddle_tpu.observability.metrics import Histogram, get_registry
+from paddle_tpu.serving import (Fault, FaultPlan, FleetWatcher,
+                                RejectedError, RemoteReplica,
+                                ReplicaRouter, Scheduler,
+                                start_http_frontend)
+
+_NOSLEEP = lambda s: None                      # noqa: E731
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    yield
+    H.disable_health()
+    T.disable_flight_recorder()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny_config())
+    m.eval()
+    return m
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class Tracker:
+    """Per-rid event log + terminal accounting (chaos invariant)."""
+
+    def __init__(self):
+        self.events = {}
+        self.terminals = {}
+
+    def cb(self, rid):
+        def on_ev(ev):
+            self.events.setdefault(rid, []).append(ev)
+            if ev["type"] in ("finished", "cancelled", "shed"):
+                self.terminals.setdefault(rid, []).append(ev)
+        return on_ev
+
+
+def _direct(model, prompt, n):
+    eng = LLMEngine(model, max_seqs=4, max_len=64, page_size=8)
+    eng.add_request("ref", prompt, max_new_tokens=n)
+    while eng.has_work():
+        eng.step()
+    return eng.result("ref")
+
+
+def _mk_replica(model, max_queue=4):
+    eng = LLMEngine(model, max_seqs=2, max_len=64, page_size=8)
+    return Scheduler(eng, max_queue=max_queue)
+
+
+# -- sliding windows -----------------------------------------------------------
+class TestSlidingWindow:
+    def test_rotation_expires_old_slots(self):
+        clock = FakeClock(1.0)
+        w = H.SlidingWindow(window=60.0, slots=12, clock=clock)
+        w.inc()                                 # slot of t=1
+        clock.t = 59.0
+        w.inc(bad=1)                            # slot of t=59
+        assert w.count() == 2 and w.bad() == 1
+        clock.t = 61.0                          # t=1 slot just expired
+        assert w.count() == 1 and w.bad() == 1
+        assert w.bad_fraction() == 1.0
+        clock.t = 130.0                         # everything expired
+        assert w.count() == 0
+        assert w.bad_fraction() is None         # unknown, not healthy
+        assert w.mean() is None
+
+    def test_weighted_observe_and_snapshot(self):
+        clock = FakeClock(1.0)
+        w = H.SlidingWindow(window=60.0, slots=6, clock=clock)
+        w.observe(0.25, n=4, bad=2)
+        assert w.count() == 4 and w.bad() == 2
+        assert w.sum() == pytest.approx(1.0)
+        assert w.mean() == pytest.approx(0.25)
+        assert w.rate() == pytest.approx(4 / 60.0)
+        snap = w.snapshot()
+        assert snap["count"] == 4 and snap["bad"] == 2
+        assert "buckets" not in snap            # no bounds: ratio view
+
+    def test_quantile_interpolates_clamps_and_empty_is_none(self):
+        clock = FakeClock(1.0)
+        w = H.SlidingWindow(window=60.0, slots=6, bounds=(0.1, 1.0),
+                            clock=clock)
+        assert w.quantile(0.95) is None         # empty
+        for v in (0.05, 0.07, 0.02, 0.09):      # all in the 0.1 bucket
+            w.observe(v)
+        assert w.quantile(0.5) == pytest.approx(0.05)
+        w.observe(5.0)                          # past the last bound
+        assert w.quantile(1.0) == pytest.approx(1.0)   # clamps
+        snap = w.snapshot()
+        assert snap["buckets"]["+Inf"] == 5
+        assert snap["p99"] is not None
+
+
+# -- SLO burn rates ------------------------------------------------------------
+class TestSLOTracker:
+    def test_event_burn_rates_and_burning(self):
+        clock = FakeClock(1.0)
+        tr = H.SLOTracker([H.SLO("err", objective=0.1)], clock=clock,
+                          fast_burn=2.0, slow_burn=1.0)
+        tr.event("err", bad=True)
+        tr.event("err", bad=False)
+        assert tr.burn_rate("err", "fast") == pytest.approx(5.0)
+        assert tr.burn_rate("err", "slow") == pytest.approx(5.0)
+        assert tr.burning("err") is True
+        st = tr.status()["err"]
+        assert st["burning"] is True
+        assert st["windows"]["fast"]["events"] == 2
+        assert st["windows"]["fast"]["bad_fraction"] == 0.5
+
+    def test_burning_requires_both_windows(self):
+        clock = FakeClock(1.0)
+        tr = H.SLOTracker([H.SLO("err", objective=0.1)],
+                          fast_window=60.0, slow_window=600.0,
+                          clock=clock)
+        for _ in range(4):
+            tr.event("err", bad=True)
+        assert tr.burning("err") is True
+        clock.advance(120.0)        # bad events leave the fast window
+        assert tr.burn_rate("err", "fast") is None
+        assert tr.burn_rate("err", "slow") == pytest.approx(10.0)
+        assert tr.burning("err") is False       # slow alone can't page
+
+    def test_threshold_slos_and_unknown_names_noop(self):
+        clock = FakeClock(1.0)
+        tr = H.SLOTracker(clock=clock)          # DEFAULT_SLOS
+        tr.observe("ttft", 2.0)                 # > 1s threshold: bad
+        tr.observe("ttft", 0.1, n=3)            # three good ones
+        assert tr.burn_rate("ttft", "fast") == pytest.approx(
+            0.25 / 0.05)
+        tr.observe("nope", 1.0)                 # unknown: no-op
+        tr.event("nope", bad=True)
+        assert tr.burn_rate("nope") is None
+
+
+# -- federation merge ----------------------------------------------------------
+class TestFederationMerge:
+    def test_merge_matches_single_process_oracle(self):
+        bounds = (0.05, 0.1, 0.5, 1.0)
+        h1 = Histogram("h1", buckets=bounds)
+        h2 = Histogram("h2", buckets=bounds)
+        oracle = Histogram("oracle", buckets=bounds)
+        for v in (0.01, 0.07, 0.2, 0.9, 3.0):
+            h1.observe(v)
+            oracle.observe(v)
+        for v in (0.03, 0.6, 0.08):
+            h2.observe(v)
+            oracle.observe(v)
+        merged = H.merge_histogram_snapshots(
+            [h1.snapshot(), None, h2.snapshot()])
+        want = oracle.snapshot()
+        assert merged["count"] == want["count"] == 8
+        assert merged["sum"] == pytest.approx(want["sum"])
+        assert merged["buckets"] == want["buckets"]
+        for q in ("p50", "p95", "p99"):
+            assert merged[q] == pytest.approx(want[q])
+
+    def test_merge_empty_and_quantile_empty(self):
+        assert H.merge_histogram_snapshots([]) is None
+        assert H.merge_histogram_snapshots([None, {"count": 3}]) is None
+        assert H.quantile_from_buckets({}, 0.5) is None
+        assert H.quantile_from_buckets({"1": 0, "+Inf": 0}, 0.5) is None
+
+
+# -- disabled-is-free ----------------------------------------------------------
+class TestDisabledFree:
+    def test_disabled_identity_singletons(self):
+        assert H.get_health() is H.NULL_HEALTH
+        assert H.get_health().goodput is H.NULL_GOODPUT
+        assert H.goodput_region("productive_step") is H.NULL_REGION
+        assert H.goodput_region("compile") is H.NULL_REGION
+        with H.goodput_region("data_stall"):
+            pass                                # a usable no-op
+        assert H.get_health().sentinel_check(loss=float("nan")) is None
+        assert H.get_health().snapshot() is None
+        assert H.NULL_GOODPUT.report()["goodput"] is None
+        # enable installs a real hub; disable restores the singleton
+        hub = H.enable_health()
+        assert H.get_health() is hub and hub.enabled
+        H.disable_health()
+        assert H.get_health() is H.NULL_HEALTH
+
+    def test_disabled_no_health_key_in_snapshots(self, model):
+        sched = _mk_replica(model)
+        sched.submit("d1", [5, 9, 2], max_new_tokens=2)
+        sched.run_until_idle()
+        assert "health" not in sched.metrics_snapshot()
+        router = ReplicaRouter([_mk_replica(model)], sleep=_NOSLEEP)
+        assert "health" not in router.fleet_snapshot()
+
+
+# -- the enabled plane in the serving tier -------------------------------------
+class TestEnabledServing:
+    def test_enabled_tokens_bit_identical_no_new_compiles(self, model):
+        want = _direct(model, [5, 9, 2, 14], 8)
+        pc = LLMEngine.prefill_compiles()
+        H.enable_health()
+        sched = _mk_replica(model)
+        sched.submit("p1", [5, 9, 2, 14], max_new_tokens=8)
+        sched.run_until_idle()
+        assert sched.result("p1") == want       # bit-identical
+        assert LLMEngine.prefill_compiles() <= max(pc, 1)
+        snap = sched.metrics_snapshot()
+        assert snap["health"]["enabled"] is True
+        win = snap["health"]["windows"]
+        assert win["ttft"]["count"] == 1        # one first token
+        assert win["tpot"]["count"] >= 1        # n-weighted decodes
+        assert win["ttft"]["p95"] is not None
+
+    def test_shed_and_error_slo_events(self, model):
+        H.enable_health()
+        sched = _mk_replica(model, max_queue=1)
+        sched.submit("s1", [5, 9, 2], max_new_tokens=2)
+        with pytest.raises(RejectedError):
+            sched.submit("s2", [5, 9, 2], max_new_tokens=2)
+        sched.run_until_idle()
+        st = H.get_health().slo.status()
+        shed = st["shed_rate"]["windows"]["fast"]
+        assert shed["events"] == 2 and shed["bad"] == 1
+        err = st["error_rate"]["windows"]["fast"]
+        assert err["events"] == 1 and err["bad"] == 0
+
+    def test_statusz_windowed_ttft_renders_na(self, model):
+        H.enable_health()
+        fe = start_http_frontend(_mk_replica(model))
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                              timeout=120)
+            conn.request("GET", "/statusz")
+            out = json.loads(conn.getresponse().read())
+        finally:
+            fe.shutdown()
+        view = out["target"]["ttft_seconds"]
+        assert view["count"] == 0
+        assert view["p95"] == "n/a"             # unknown, not 0.0
+        assert view["window_seconds"] == 60.0
+
+
+# -- fleet federation ----------------------------------------------------------
+class TestFleetFederation:
+    def test_in_process_fleet_snapshot_merges(self, model):
+        router = ReplicaRouter([_mk_replica(model), _mk_replica(model)],
+                               sleep=_NOSLEEP)
+        for i in range(3):
+            router.submit(f"f{i}", [5, 9, 2 + i], max_new_tokens=4)
+        router.run_until_idle()
+        snap = router.fleet_snapshot()
+        fleet = snap["fleet"]
+        assert fleet["replicas"] == 2 and fleet["scraped"] == 2
+        assert fleet["stale"] == 0
+        assert fleet["admitted"] == 3 and fleet["completed"] == 3
+        assert fleet["generated_tokens"] == 12
+        # merged histogram count equals the per-replica oracle sum
+        per = sum(r["metrics"]["engine"]["ttft_seconds"]["count"]
+                  for r in snap["replicas"])
+        assert fleet["ttft_seconds"]["count"] == per == 3
+        assert fleet["queue_wait_seconds"]["count"] >= 3
+        for row in snap["replicas"]:
+            assert row["stale"] is False
+            assert isinstance(row["load"], int)
+
+    def test_ejected_replica_is_stale_never_scraped(self, model):
+        router = ReplicaRouter([_mk_replica(model), _mk_replica(model)],
+                               sleep=_NOSLEEP)
+        with router._lock:
+            router._ejected.add(1)
+        snap = router.fleet_snapshot()
+        rows = snap["replicas"]
+        assert rows[1]["ejected"] and rows[1]["stale"]
+        assert rows[1]["metrics"] is None       # dead to the router
+        assert snap["fleet"]["scraped"] == 1
+        assert snap["fleet"]["stale"] == 1
+
+    @pytest.fixture()
+    def rig(self, model):
+        made = []
+
+        def make(n=2):
+            fes, scheds = [], []
+            for _ in range(n):
+                eng = LLMEngine(model, max_seqs=4, max_len=64,
+                                page_size=8)
+                sc = Scheduler(eng, max_queue=8)
+                scheds.append(sc)
+                fes.append(start_http_frontend(sc))
+            made.extend(fes)
+            reps = [RemoteReplica(fe.url, timeout=30, sleep=_NOSLEEP)
+                    for fe in fes]
+            router = ReplicaRouter(reps, sleep=_NOSLEEP)
+            return fes, scheds, reps, router
+
+        yield make
+        for fe in made:
+            try:
+                fe.shutdown(drain=False)
+            except Exception:
+                pass
+
+    def test_remote_scrape_and_http_fleetz(self, model, rig):
+        fes, scheds, reps, router = rig()
+        router.submit("r1", [5, 9, 2], max_new_tokens=4)
+        router.run_until_idle(max_steps=5000)
+        # the new verb answers the scheduler snapshot over HTTP
+        conn = http.client.HTTPConnection("127.0.0.1", fes[0].port,
+                                          timeout=120)
+        conn.request("GET", "/v1/metrics_snapshot")
+        direct = json.loads(conn.getresponse().read())
+        assert direct["admitted"] == scheds[0].metrics_snapshot()[
+            "admitted"]
+        snap = router.fleet_snapshot()
+        assert snap["fleet"]["admitted"] == 1
+        assert snap["fleet"]["completed"] == 1
+        assert snap["fleet"]["stale"] == 0
+        # /fleetz on a router frontend serves the federated view;
+        # on a single-scheduler frontend, a fleet of one
+        fr = start_http_frontend(router)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", fr.port,
+                                              timeout=120)
+            conn.request("GET", "/fleetz")
+            fz = json.loads(conn.getresponse().read())
+        finally:
+            fr.shutdown(drain=False)
+        assert fz["fleet"]["replicas"] == 2
+        assert fz["fleet"]["admitted"] == 1
+        conn = http.client.HTTPConnection("127.0.0.1", fes[0].port,
+                                          timeout=120)
+        conn.request("GET", "/fleetz")
+        one = json.loads(conn.getresponse().read())
+        assert one["router"] is None
+        assert one["fleet"]["replicas"] == 1
+        assert one["replicas"][0]["metrics"]["admitted"] == 1
+
+    def test_mid_scrape_timeout_marks_stale_not_raise(self, model, rig):
+        fes, scheds, reps, router = rig()
+        router.submit("t1", [5, 9, 2], max_new_tokens=4)
+        router.run_until_idle(max_steps=5000)
+        plan = FaultPlan([Fault(op="poll", kind="timeout", nth=1,
+                                times=None)], sleep=_NOSLEEP)
+        reps[1].set_fault_plan(plan)
+        snap = router.fleet_snapshot()          # partial, not an error
+        rows = snap["replicas"]
+        assert rows[0]["stale"] is False
+        assert rows[1]["stale"] is True and "error" in rows[1]
+        assert snap["fleet"]["scraped"] == 1
+        assert snap["fleet"]["stale"] == 1
+        assert snap["fleet"]["admitted"] == 1   # fresh replicas only
+        reps[1].set_fault_plan(None)            # scrape recovers
+        snap2 = router.fleet_snapshot()
+        assert snap2["fleet"]["stale"] == 0
+        assert snap2["fleet"]["scraped"] == 2
+
+
+# -- goodput accounting --------------------------------------------------------
+class _ArrDataset(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(23)
+        self.x = rng.normal(size=(n, 6)).astype(np.float32)
+        self.y = rng.normal(size=(n, 3)).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _LossHistory(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(np.asarray(logs["loss"])))
+
+
+class _StopAfter(Callback):
+    def __init__(self, n):
+        super().__init__()
+        self.n = n
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen >= self.n:
+            self.model.stop_training = True
+
+
+def _make_model(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
+    model = paddle.Model(net)
+    model.prepare(optimizer.AdamW(learning_rate=5e-3), nn.MSELoss())
+    return model
+
+
+def _make_loader():
+    return CheckpointableLoader(_ArrDataset(), batch_size=4,
+                                shuffle=True, seed=7)
+
+
+class TestGoodput:
+    def test_meter_fractions_sum_to_one(self):
+        clock = FakeClock(100.0)
+        m = H.GoodputMeter(clock=clock)
+        m.add("compile", 5.0)                   # no run open: dropped
+        assert m.report()["running"] is False
+        m.start()
+        with m.region("productive_step"):
+            clock.advance(6.0)
+        with m.region("checkpoint_save"):
+            clock.advance(1.0)
+        clock.advance(3.0)                      # unattributed wall time
+        m.stop()
+        rep = m.report()
+        assert rep["total_seconds"] == pytest.approx(10.0)
+        f = rep["fractions"]
+        assert sum(f.values()) == pytest.approx(1.0, abs=1e-6)
+        assert f["productive_step"] == pytest.approx(0.6)
+        assert f["checkpoint_save"] == pytest.approx(0.1)
+        assert f["other"] == pytest.approx(0.3)
+        assert rep["goodput"] == pytest.approx(0.6)
+        with pytest.raises(EnforceError):
+            m.region("not_a_bucket")
+        m.start()                               # reopen resets buckets
+        assert m.report()["seconds"].get("productive_step", 0.0) == 0.0
+
+    def test_fit_goodput_chaos_interrupt_then_resume(self, tmp_path):
+        H.enable_health()
+        hist = _LossHistory()
+        _make_model(1).fit(
+            _make_loader(), epochs=2, verbose=0,
+            callbacks=[hist, _StopAfter(5)],    # the injected kill
+            checkpoint_dir=str(tmp_path / "ck"), save_steps=3)
+        rep1 = H.get_health().goodput.report()
+        assert rep1["running"] is False
+        f1 = rep1["fractions"]
+        assert sum(f1.values()) == pytest.approx(1.0, abs=1e-6)
+        assert rep1["seconds"]["restart_replay"] == 0.0   # fresh run
+        assert rep1["seconds"]["compile"] > 0.0
+        assert rep1["seconds"]["productive_step"] > 0.0
+        assert rep1["seconds"]["checkpoint_save"] > 0.0
+        assert rep1["seconds"]["data_stall"] > 0.0
+        # resumed "fresh process": only now is replay time booked
+        _make_model(9).fit(
+            _make_loader(), epochs=2, verbose=0,
+            checkpoint_dir=str(tmp_path / "ck"), save_steps=3,
+            auto_resume=True)
+        rep2 = H.get_health().goodput.report()
+        f2 = rep2["fractions"]
+        assert sum(f2.values()) == pytest.approx(1.0, abs=1e-6)
+        assert rep2["seconds"]["restart_replay"] > 0.0
+        assert rep2["goodput"] > 0.0
+        # the registry gauges publish the fractions on snapshot
+        H.get_health().snapshot()
+        text = get_registry().expose_text()
+        assert "train_goodput_fraction" in text
+
+
+# -- anomaly sentinels ---------------------------------------------------------
+class TestSentinel:
+    def test_nan_trips_immediately_any_policy(self):
+        for policy in ("warn", "skip_step", "halt"):
+            s = H.AnomalySentinel(policy=policy, warmup=50)
+            assert s.check(step=1, loss=1.0) is None
+            assert s.check(step=2, loss=float("nan")) == policy
+            assert s.check(step=3, loss=float("inf")) == policy
+            assert [t["reason"] for t in s.trips] == ["non_finite"] * 2
+        with pytest.raises(EnforceError):
+            H.AnomalySentinel(policy="explode")
+
+    def test_ewma_spike_after_warmup_only(self):
+        s = H.AnomalySentinel(policy="halt", warmup=3)
+        assert s.check(loss=1.0) is None
+        assert s.check(loss=50.0) is None       # warmup: absorbed
+        s2 = H.AnomalySentinel(policy="halt", warmup=3,
+                               spike_factor=6.0)
+        for _ in range(4):
+            assert s2.check(loss=1.0) is None
+        mean_before = s2.snapshot()["metrics"]["loss"]["mean"]
+        assert s2.check(loss=1.01) is None      # inside the band
+        assert s2.check(step=7, loss=50.0) == "halt"
+        trip = s2.trips[0]
+        assert trip["step"] == 7 and "ewma_spike" in trip["reason"]
+        # the spike never becomes the new baseline
+        assert s2.snapshot()["metrics"]["loss"]["mean"] == \
+            pytest.approx(mean_before, rel=0.1)
+        assert s2.check(loss=None) is None      # missing tap: skipped
+
+    def test_trips_record_events_and_dump_once(self, tmp_path):
+        rec = T.enable_flight_recorder(
+            path=str(tmp_path / "fr.jsonl"))
+        s = H.AnomalySentinel(policy="warn", warmup=50)
+        s.check(step=4, loss=float("nan"))
+        evs = rec.recent(kind="anomaly")
+        assert evs and evs[-1]["metric"] == "loss"
+        assert evs[-1]["reason"] == "non_finite"
+        assert (tmp_path / "fr.jsonl").exists()
+        before = (tmp_path / "fr.jsonl").read_bytes()
+        s.check(step=5, loss=float("nan"))      # same reason: one dump
+        assert (tmp_path / "fr.jsonl").read_bytes() == before
+
+    def test_fit_halts_on_nan_loss(self):
+        H.enable_health(sentinel_policy="halt")
+        m = _make_model(2)
+        m.train_batch = lambda ins, labs: [float("nan")]
+        hist = _LossHistory()
+        m.fit(_make_loader(), epochs=1, verbose=0, callbacks=[hist])
+        assert len(hist.losses) == 1            # stopped after the trip
+        trips = H.get_health().sentinel.trips
+        assert trips and trips[0]["policy"] == "halt"
+        assert "train_anomaly_trips_total" in \
+            get_registry().expose_text()
+
+
+# -- the autopilot -------------------------------------------------------------
+class _StubReplica:
+    def __init__(self, log, idx):
+        self.log = log
+        self.idx = idx
+
+    def resume_admission(self):
+        self.log.append(("resume_admission", self.idx))
+
+
+class StubRouter:
+    """Canned fleet_snapshot + recorded actuator calls — the watcher
+    policy under a microscope."""
+
+    def __init__(self, rows):
+        self.rows = rows
+        self.calls = []
+        self.replicas = [_StubReplica(self.calls, i)
+                         for i in range(len(rows))]
+
+    def fleet_snapshot(self):
+        return {"replicas": [dict(r) for r in self.rows]}
+
+    def mark_slow(self, i):
+        self.calls.append(("mark_slow", i))
+
+    def drain_replica(self, i):
+        self.calls.append(("drain", i))
+
+    def reinstate(self, i):
+        self.calls.append(("reinstate", i))
+
+
+def _row(i, load=0, burning=False, stale=False, ejected=False):
+    return {"replica": i, "ejected": ejected, "stale": stale,
+            "load": load,
+            "slo": {"ttft": {"burning": burning}} if burning else {}}
+
+
+class TestFleetWatcher:
+    def test_burn_trip_marks_slow_once_then_reinstates(self, tmp_path):
+        rec = T.enable_flight_recorder(
+            path=str(tmp_path / "fr.jsonl"))
+        clock = FakeClock(100.0)
+        rows = [_row(0, load=1, burning=True), _row(1, load=1)]
+        router = StubRouter(rows)
+        w = FleetWatcher(router, clock=clock, burn_trip_ticks=3,
+                         clear_ticks=2, replica_cooldown=0.0,
+                         max_actions_per_min=10)
+        for _ in range(2):
+            w.tick()
+            clock.advance(1.0)
+        assert router.calls == []               # hysteresis holds
+        w.tick()
+        clock.advance(1.0)
+        assert router.calls == [("mark_slow", 0)]
+        w.tick()                                # still burning: no re-act
+        clock.advance(1.0)
+        assert router.calls == [("mark_slow", 0)]
+        rows[0] = _row(0, load=1)               # recovered
+        for _ in range(2):
+            w.tick()
+            clock.advance(1.0)
+        assert router.calls == [("mark_slow", 0), ("reinstate", 0)]
+        assert ("resume_admission", 0) not in router.calls  # not drained
+        acts = [e["action"] for e in rec.recent(kind="autopilot")]
+        assert acts == ["mark_slow", "reinstate"]  # every action explained
+
+    def test_skew_trip_drains_then_resumes_admission(self):
+        clock = FakeClock(100.0)
+        rows = [_row(0, load=20), _row(1, load=2)]
+        router = StubRouter(rows)
+        w = FleetWatcher(router, clock=clock, skew_ratio=3.0,
+                         skew_min_load=8, skew_trip_ticks=2,
+                         clear_ticks=2, replica_cooldown=0.0,
+                         max_actions_per_min=10)
+        for _ in range(2):
+            w.tick()
+            clock.advance(1.0)
+        assert router.calls == [("drain", 0)]
+        rows[0] = _row(0, load=0)               # drained empty
+        for _ in range(2):
+            w.tick()
+            clock.advance(1.0)
+        assert router.calls == [("drain", 0), ("resume_admission", 0),
+                                ("reinstate", 0)]
+        snap = w.snapshot()
+        assert [a["action"] for a in snap["actions"]] == \
+            ["drain", "reinstate"]
+        assert snap["policy"][0]["drained"] is False
+
+    def test_action_rate_bounded_and_cooldown(self):
+        clock = FakeClock(100.0)
+        rows = [_row(0, load=1, burning=True),
+                _row(1, load=1, burning=True)]
+        router = StubRouter(rows)
+        w = FleetWatcher(router, clock=clock, burn_trip_ticks=1,
+                         clear_ticks=1, replica_cooldown=200.0,
+                         max_actions_per_min=1)
+        w.tick()
+        assert len(router.calls) == 1           # global bucket: 1/min
+        for _ in range(10):
+            clock.advance(1.0)
+            w.tick()
+        assert len(router.calls) == 1
+        clock.advance(61.0)                     # bucket refills
+        w.tick()
+        assert router.calls == [("mark_slow", 0), ("mark_slow", 1)]
+        rows[0] = _row(0, load=1)               # replica 0 recovers
+        rows[1] = _row(1, load=1)
+        clock.advance(61.0)                     # budget free again...
+        w.tick()
+        assert len(router.calls) == 2           # ...but cooldown holds
+        clock.advance(200.0)
+        w.tick()
+        assert ("reinstate", 0) in router.calls
+
+    def test_stale_and_ejected_rows_never_trip(self):
+        clock = FakeClock(100.0)
+        rows = [_row(0, load=50, burning=True, stale=True),
+                _row(1, load=1, burning=True, ejected=True)]
+        router = StubRouter(rows)
+        w = FleetWatcher(router, clock=clock, burn_trip_ticks=1,
+                         skew_trip_ticks=1, replica_cooldown=0.0)
+        for _ in range(5):
+            w.tick()
+            clock.advance(1.0)
+        assert router.calls == []               # no data, no action
+        pol = w.snapshot()["policy"]
+        assert pol[1]["burn_streak"] == 0       # prober's jurisdiction
+
+    def test_watcher_thread_start_stop(self):
+        import time
+        router = StubRouter([_row(0, load=1)])
+        w = FleetWatcher(router, interval=0.02, replica_cooldown=0.0)
+        w.start()
+        with pytest.raises(EnforceError):
+            w.start()                           # no double-start
+        deadline = time.monotonic() + 5.0
+        while w.ticks < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        w.stop()
+        assert w.ticks >= 2
+        assert w._thread is None                # joined: no leak
+        w.stop()                                # idempotent
+
+    def test_watcher_drains_skewed_replica_no_lost_requests(
+            self, model):
+        want = _direct(model, [5, 9, 2], 6)
+        scheds = [_mk_replica(model), _mk_replica(model)]
+        router = ReplicaRouter(scheds, sleep=_NOSLEEP)
+        clock = FakeClock(100.0)
+        w = FleetWatcher(router, clock=clock, skew_ratio=2.0,
+                         skew_min_load=3, skew_trip_ticks=2,
+                         clear_ticks=2, burn_trip_ticks=2,
+                         replica_cooldown=0.0, max_actions_per_min=10)
+        router.mark_slow(1)                     # pile load onto 0
+        tr = Tracker()
+        rids = [f"c{i}" for i in range(4)]
+        for r in rids:
+            assert router.submit(r, [5, 9, 2], max_new_tokens=6,
+                                 on_event=tr.cb(r)) == 0
+        router.reinstate(1)                     # 1 is back and idle
+        for _ in range(2):                      # hysteresis, then drain
+            w.tick()
+            clock.advance(1.0)
+        assert [a["action"] for a in w.actions] == ["drain"]
+        with pytest.raises(RejectedError):      # admission stopped
+            scheds[0].submit("refused", [5], max_new_tokens=1)
+        router.run_until_idle(max_steps=8000)
+        # the chaos invariant: every rid exactly one terminal, tokens
+        # bit-identical after the KV migration
+        for r in rids:
+            assert [e["type"] for e in tr.terminals[r]] == ["finished"]
+            assert router.pop_result(r) == want
+        for _ in range(2):                      # recovery: reinstate
+            w.tick()
+            clock.advance(1.0)
+        assert [a["action"] for a in w.actions] == ["drain", "reinstate"]
+        assert 0 in router.healthy_replicas()
+        assert router.submit("after", [5, 9, 2], max_new_tokens=2) \
+            in (0, 1)                           # admission resumed
+        router.run_until_idle(max_steps=8000)
+        for _ in range(4):                      # calm fleet: no flapping
+            w.tick()
+            clock.advance(1.0)
+        assert len(w.actions) == 2              # action rate bounded
+        assert "serving_autopilot_actions_total" in \
+            get_registry().expose_text()
+
+
+# -- tier-1 budget guard -------------------------------------------------------
+def test_tier1_budget_guard_fleet_health():
+    """This module's fast tests stay bounded (the 870 s tier-1 budget)
+    and the disabled plane costs one global read — re-asserted here so
+    a refactor can't quietly break the identity contract."""
+    assert H.get_health() is H.NULL_HEALTH
+    assert H.goodput_region("compile") is H.NULL_REGION
+    src = (Path(__file__).resolve().parent
+           / "test_fleet_health.py").read_text()
+    n_fast = 0
+    for m in re.finditer(r"((?:@[\w.]+(?:\(.*?\))?\s*\n\s*)*)"
+                         r"def (test_\w+)\(", src):
+        if "soak" in m.group(2):
+            assert "pytest.mark.slow" in m.group(1), (
+                f"{m.group(2)} must be @pytest.mark.slow")
+        if "pytest.mark.slow" not in m.group(1):
+            n_fast += 1
+    assert n_fast <= 30, (
+        f"{n_fast} fast fleet-health tests — move heavy ones behind "
+        f"@pytest.mark.slow to protect the 870 s tier-1 budget")

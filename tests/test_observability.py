@@ -37,6 +37,23 @@ def _golden_registry() -> MetricRegistry:
     h.observe(0.05)
     h.observe(0.5, n=3)
     h.observe(2.0)
+    # the fleet health plane's families (PR 11): windowed burn rates,
+    # goodput fractions, autopilot actions
+    b = r.gauge("serving_slo_burn_rate",
+                "Windowed SLO burn rate (bad fraction / objective).",
+                labelnames=("slo", "window"))
+    b.labels("ttft", "fast").set(2.5)
+    b.labels("ttft", "slow").set(1.25)
+    gp = r.gauge("train_goodput_fraction",
+                 "Fraction of training wall time in the bucket.",
+                 labelnames=("bucket",))
+    gp.labels("productive_step").set(0.9)
+    gp.labels("other").set(0.1)
+    a = r.counter("serving_autopilot_actions_total",
+                  "Rebalancing actions the FleetWatcher took.",
+                  labelnames=("action",))
+    a.labels("mark_slow").inc(2)
+    a.labels("drain").inc()
     return r
 
 

@@ -666,7 +666,7 @@ class TestHistogramQuantiles:
     def test_snapshot_and_empty(self):
         reg = MetricRegistry()
         h = reg.histogram("q2", buckets=(1.0, 2.0))
-        assert h.snapshot()["p95"] == 0.0
+        assert h.snapshot()["p95"] is None   # empty: no percentile
         h.observe(1.5, n=100)
         snap = h.snapshot()
         assert set(snap) >= {"count", "sum", "mean", "buckets",
