@@ -1147,6 +1147,100 @@ def bench_fleet_health():
                       "budget": "overhead <= 3%"}}
 
 
+def bench_introspection():
+    """Compile/memory introspection-plane overhead row (ISSUE 15):
+    decode tokens/sec through the SAME router-fronted scheduler
+    workload with the CompileWatch off vs on.  Off is a strict no-op
+    (watched_call reads one module global and tail-calls the jit
+    function — the budget-guard test pins the NULL identity); ON adds
+    a jit-cache-size read around each dispatch WINDOW plus, on the
+    window that actually compiles, one AOT lowering for cost analysis
+    — so the acceptance bar is <=3% throughput overhead with tokens
+    bit-identical and the one-compile counters unchanged.  The ON arm
+    also scrapes /compilez-shaped and /memz-shaped snapshots each
+    iteration (the realistic always-on cost of a dashboard poll)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import introspection as obs_insp
+    from paddle_tpu.serving import ReplicaRouter, Scheduler
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 256, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 4, 96, 8, 128, 4
+        prompts = [8, 5, 12, 9]
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if not on_tpu:
+        dtype = np.float32
+
+    def run(enable):
+        if enable:
+            obs_insp.enable_compile_watch()
+        else:
+            obs_insp.disable_compile_watch()
+        try:
+            rng = np.random.default_rng(0)
+            eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                            page_size=page, dtype=dtype,
+                            steps_per_sync=sync)
+            sched = Scheduler(eng)
+            router = ReplicaRouter([sched], sleep=lambda s: None)
+            for i, plen in enumerate(prompts):
+                router.submit(
+                    f"c{i}",
+                    rng.integers(1, cfg.vocab_size, plen).tolist(),
+                    max_new_tokens=new)
+            sched.step()               # warmup: compiles the window
+            produced0 = sum(len(r.out)
+                            for r in eng.requests.values())
+            t0 = time.perf_counter()
+            sched.run_until_idle()
+            dt = time.perf_counter() - t0
+            snap = None
+            if enable:
+                # the dashboard-poll cost rides inside the ON arm
+                snap = obs_insp.compilez_snapshot()
+                obs_insp.memz_snapshot()
+            total = sum(
+                len(sched.result(f"c{i}"))
+                for i in range(len(prompts))) - produced0
+            return total / dt, eng, snap
+        finally:
+            obs_insp.disable_compile_watch()
+
+    run(True)                          # shared compile + cache warmup
+    off, on = [], []
+    eng_on, snap_on = None, None
+    for _ in range(5):                 # interleaved best-of (clock
+        off.append(run(False)[0])      # drift hits both arms equally)
+        rate, eng_on, snap_on = run(True)
+        on.append(rate)
+    n_recompiles = len(snap_on["recompiles"])
+    best_off, best_on = max(off), max(on)
+    overhead = (best_off - best_on) / best_off
+    return {"metric": "llama_serving_introspection_overhead_pct",
+            "unit": "percent", "value": round(overhead * 100, 2),
+            "extra": {"device_kind": kind,
+                      "tokens_per_sec_watch_off": round(best_off, 1),
+                      "tokens_per_sec_watch_on": round(best_on, 1),
+                      "prefill_compiles": eng_on.prefill_compiles(),
+                      "mixed_compiles": eng_on.mixed_compiles(),
+                      "recompile_events": n_recompiles,
+                      "budget": "overhead <= 3%"}}
+
+
 def bench_serving_prefix():
     """Automatic-prefix-caching row (ISSUE 3): N requests sharing a
     long system prompt, admitted through the SAME engine workload with
@@ -1933,6 +2027,7 @@ def main():
                ("bench_serving_metrics", bench_serving_metrics),
                ("bench_trace", bench_trace),
                ("bench_fleet_health", bench_fleet_health),
+               ("bench_introspection", bench_introspection),
                ("bench_serving_prefix", bench_serving_prefix),
                ("bench_serving_sched", bench_serving_sched),
                ("bench_serving_preempt", bench_serving_preempt),

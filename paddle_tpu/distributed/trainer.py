@@ -144,6 +144,10 @@ class ShardedTrainStep(CompiledTrainStep):
             in_shardings=(shardings, None, None, None),
             out_shardings=(shardings, None),
             donate_argnums=(0,) if self._donate else ())
+        from ..observability import introspection as _insp
+        _insp.get_compile_watch().register_program(self._program_name)
+
+    _program_name = "train.sharded_step"
 
     def __call__(self, batch):
         if self._step_fn is None:
@@ -155,6 +159,7 @@ class ShardedTrainStep(CompiledTrainStep):
         # per step, fence on the sharded outputs so multi-chip async
         # dispatch can't flatter step time
         from ..observability import health as _health
+        from ..observability import introspection as _insp
         from ..observability import tracing as _tracing
         span = _tracing.span("train.compiled_step")
         span.set_attr("step", self._step_count)
@@ -164,7 +169,11 @@ class ShardedTrainStep(CompiledTrainStep):
                 else "compile"):
             if self._timer is not None:
                 self._timer.start()
-            self.state, loss = self._step_fn(self.state, batch, sub, lr)
+            self.state, loss = _insp.watched_call(
+                self._program_name, self._step_fn,
+                self.state, batch, sub, lr)
+            if self._grad_norm_tap:
+                loss, self.last_grad_norm = loss
             if self._timer is not None:
                 self._timer.stop(fence=(self.state, loss))
         self._compiled_once = True

@@ -55,11 +55,18 @@ class Model:
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, fused_step: bool = True):
+                amp_configs=None, fused_step: bool = True,
+                grad_norm_tap: bool = False):
         # fused_step: run the compiled step's optimizer update through
         # the fused clip+update path (jit/train.py; bit-identical to
         # False, which keeps the per-leaf reference loop for debugging)
         self._fused_step = bool(fused_step)
+        # grad_norm_tap: the compiled step also returns the f32 global
+        # grad norm, which fit feeds to the AnomalySentinel alongside
+        # the loss — exploding gradients trip a step before the loss
+        # spike.  Off by default (the extra step output can move XLA
+        # fusion boundaries by an ulp, which parity tests pin).
+        self._grad_norm_tap = bool(grad_norm_tap)
         self._optimizer = optimizer
         if loss is not None:
             enforce(callable(loss), "loss must be callable (a Layer or fn)")
@@ -115,14 +122,15 @@ class Model:
             # training forward's predictions (has_aux) so per-batch
             # train metrics cost no extra forward
             fused = getattr(self, "_fused_step", True)
+            tap = getattr(self, "_grad_norm_tap", False)
             if self._metrics:
                 self._train_step = CompiledTrainStep(
                     self.network, self._loss_fn_aux, self._optimizer,
-                    has_aux=True, fused_step=fused)
+                    has_aux=True, fused_step=fused, grad_norm_tap=tap)
             else:
                 self._train_step = CompiledTrainStep(
                     self.network, self._loss_fn, self._optimizer,
-                    fused_step=fused)
+                    fused_step=fused, grad_norm_tap=tap)
             if self._pending_opt_state is not None:
                 self._train_step.state["opt"] = self._pending_opt_state
                 self._pending_opt_state = None
@@ -359,10 +367,18 @@ class Model:
                 logs = {"loss": self.train_batch(ins, labs)[0]}
                 # anomaly sentinel: NaN/Inf or an EWMA spike in the
                 # step loss trips the configured policy (warn /
-                # skip_step / halt) and dumps the flight recorder
+                # skip_step / halt) and dumps the flight recorder.
+                # With prepare(grad_norm_tap=True) the fused step also
+                # surfaces its f32 global grad norm, so an exploding
+                # gradient trips a step BEFORE the loss spike.
+                _sentinel_vals = {
+                    "loss": float(np.asarray(logs["loss"]).ravel()[0])}
+                _gn = getattr(self._train_step, "last_grad_norm", None)
+                if _gn is not None:
+                    _sentinel_vals["grad_norm"] = float(
+                        np.asarray(_gn).ravel()[0])
                 _act = _health.get_health().sentinel_check(
-                    step=global_step,
-                    loss=float(np.asarray(logs["loss"]).ravel()[0]))
+                    step=global_step, **_sentinel_vals)
                 if _act == "halt":
                     self.stop_training = True
                 _skip_metrics = _act == "skip_step"

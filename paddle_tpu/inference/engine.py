@@ -78,6 +78,7 @@ import numpy as np
 from ..common.errors import enforce
 from ..observability import get_registry
 from ..observability import health as _health
+from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 from ..profiler import RecordEvent
 from .paged_cache import PagedKVCache
@@ -657,6 +658,21 @@ class LLMEngine:
         self.requests: Dict[object, GenRequest] = {}
         self._active: List[GenRequest] = []
         self._init_metrics(enable_metrics)
+        # compile-watch registration: this engine's three jit entry
+        # points and their warmup allowances (the split decode program
+        # legitimately compiles one power-of-two window bucket per
+        # size, bit_length of steps_per_sync of them; prefill and the
+        # unified mixed step are strictly one-program per geometry).
+        # A no-op off one global read when the watch is disabled.
+        cw = _insp.get_compile_watch()
+        cw.register_program("engine.prefill_chunk")
+        cw.register_program("engine.decode_step",
+                            expected=int(steps_per_sync).bit_length())
+        cw.register_program("engine.mixed_step")
+        # the paged KV pool (device pages + host swap) as a first-class
+        # /memz row; weakly held so a released engine frees its pages
+        _insp.register_memory_consumer(
+            f"kv_cache:{self.engine_id}", self.cache)
 
     # -- metrics ---------------------------------------------------------------
     def _init_metrics(self, enabled: bool):
@@ -798,7 +814,8 @@ class LLMEngine:
             chunk_span.set_attr("chunk", ci).set_attr("tokens", real)
             (logits, self.cache.k_pages, self.cache.v_pages,
              self.cache.k_scales, self.cache.v_scales) = \
-                _paged_prefill_chunk(
+                _insp.watched_call(
+                    "engine.prefill_chunk", _paged_prefill_chunk,
                     self._stack, self._norm_w, self._head_w,
                     self._embed_w, self._rope_prefill,
                     self.cache.k_pages, self.cache.v_pages,
@@ -845,7 +862,8 @@ class LLMEngine:
                 [self.cache.page_table[[slot]], padt])
             (_, self.cache.k_pages, self.cache.v_pages,
              self.cache.k_scales, self.cache.v_scales) = \
-                _paged_decode_step(
+                _insp.watched_call(
+                    "engine.decode_step", _paged_decode_step,
                     self._stack, self._norm_w, self._head_w,
                     self._embed_w, self._rope, self.cache.k_pages,
                     self.cache.v_pages, self.cache.k_scales,
@@ -1089,7 +1107,8 @@ class LLMEngine:
         with RecordEvent("llm_engine.decode"):
             (toks, self.cache.k_pages, self.cache.v_pages,
              self.cache.k_scales, self.cache.v_scales) = \
-                _paged_decode_step(
+                _insp.watched_call(
+                    "engine.decode_step", _paged_decode_step,
                     self._stack, self._norm_w, self._head_w,
                     self._embed_w, self._rope, self.cache.k_pages,
                     self.cache.v_pages, self.cache.k_scales,
@@ -1244,7 +1263,8 @@ class LLMEngine:
                 for si in range(nsteps):
                     (nxt, self.cache.k_pages, self.cache.v_pages,
                      self.cache.k_scales, self.cache.v_scales, key) = \
-                        _paged_mixed_step(
+                        _insp.watched_call(
+                            "engine.mixed_step", _paged_mixed_step,
                             self._stack, self._norm_w, self._head_w,
                             self._embed_w, self._rope,
                             self.cache.k_pages, self.cache.v_pages,

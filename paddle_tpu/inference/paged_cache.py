@@ -761,6 +761,27 @@ class PagedKVCache:
                 "swap_imported_pages": int(self._m_swap_import.value),
                 "swap_fallbacks": int(self._m_swap_fallback.value)}
 
+    def memory_rows(self) -> dict:
+        """Memory-plane accounting row (observability.introspection):
+        actual bytes held by the device page pools (values + int8 scale
+        planes) and by the host swap pool's staged page copies."""
+        dev = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
+        if self.k_scales is not None:
+            dev += int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
+        host = 0
+        for entry in self._swap.values():
+            for arr in (entry.k_host, entry.v_host,
+                        entry.k_scale_host, entry.v_scale_host):
+                if arr is not None:
+                    host += int(arr.nbytes)
+        return {"device_bytes": dev,
+                "host_bytes": host,
+                "pages": int(self.n_pages),
+                "free_pages": self.free_page_count(),
+                "bytes_per_token": self.kv_bytes_per_token(),
+                "swap_pool_pages": int(self.swap_pool_pages),
+                "swap_pool_used": int(self._swap_used)}
+
     # -- device-side ops -------------------------------------------------------
     def _norm_layers(self, k, v, tokens_axis: int):
         """Accept [S?, KVH, D]-style per-layer input when num_layers==1,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..nn.layer import Layer, functional_state
 from ..observability import health as _health
+from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 from ..ops import random as _random
 from ..optimizer.optimizer import Optimizer
@@ -72,7 +73,7 @@ class CompiledTrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer: Optimizer,
                  seed: int = 0, donate: bool = True,
                  state_sharding_fn=None, has_aux: bool = False,
-                 fused_step: bool = True):
+                 fused_step: bool = True, grad_norm_tap: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -109,6 +110,13 @@ class CompiledTrainStep:
         # first dispatch pays the jit trace+compile: the goodput meter
         # books it as "compile", every later step as "productive_step"
         self._compiled_once = False
+        # grad-norm sentinel tap (default OFF): when on, the step also
+        # returns the f32 global grad norm of the SYNCED gradients so
+        # fit can feed AnomalySentinel a step before the loss spikes.
+        # Off by default because the extra output perturbs XLA's fusion
+        # clustering, which the bit-exactness parity tests pin down.
+        self._grad_norm_tap = bool(grad_norm_tap)
+        self.last_grad_norm = None
 
     # -- telemetry -----------------------------------------------------------
     def attach_timer(self, timer):
@@ -197,6 +205,7 @@ class CompiledTrainStep:
         sync_grads = self._sync_grads
 
         has_aux = self._has_aux
+        grad_norm_tap = self._grad_norm_tap
 
         def step(state, batch, key, lr):
             def pure_loss(p):
@@ -213,9 +222,17 @@ class CompiledTrainStep:
                 loss, grads = jax.value_and_grad(pure_loss)(
                     state["params"])
             grads = sync_grads(grads)
+            if grad_norm_tap:
+                # f32 global norm over the synced grads — the same
+                # quantity the clip pass derives, so XLA CSEs the two
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
             new_params, new_opt = apply_gradients(
                 state["params"], grads, state["opt"], lr)
             out = (loss, aux) if has_aux else loss
+            if grad_norm_tap:
+                out = (out, gnorm)
             return {"params": new_params, "opt": new_opt}, out
 
         return step
@@ -224,6 +241,11 @@ class CompiledTrainStep:
         _maybe_enable_debug_nans()
         self._step_fn = jax.jit(
             self._make_step(), donate_argnums=(0,) if self._donate else ())
+        _insp.get_compile_watch().register_program(self._program_name)
+
+    # CompileWatch program name for the fused step (ShardedTrainStep
+    # overrides it so the two step families are attributed separately)
+    _program_name = "train.compiled_step"
 
     def __call__(self, batch) -> jax.Array:
         if self._step_fn is None:
@@ -240,8 +262,11 @@ class CompiledTrainStep:
                 else "compile"):
             if self._timer is not None:
                 self._timer.start()
-            self.state, out = self._step_fn(self.state,
-                                            _to_arrays(batch), sub, lr)
+            self.state, out = _insp.watched_call(
+                self._program_name, self._step_fn,
+                self.state, _to_arrays(batch), sub, lr)
+            if self._grad_norm_tap:
+                out, self.last_grad_norm = out
             if self._timer is not None:
                 self._timer.stop(fence=(self.state, out))
         self._compiled_once = True
@@ -264,8 +289,12 @@ class CompiledTrainStep:
                 return traced_forward(model, eval_fn, params, batch, key)
             fn = jax.jit(run)
             self._eval_fns[id(eval_fn)] = fn
+            # each distinct eval_fn legitimately compiles once
+            _insp.get_compile_watch().register_program("train.eval_step")
         self._key, sub = jax.random.split(self._key)
-        return fn(self.state["params"], _to_arrays(batch), sub)
+        return _insp.watched_call("train.eval_step", fn,
+                                  self.state["params"],
+                                  _to_arrays(batch), sub)
 
     # -- gradient accumulation ----------------------------------------------
     def grad_step(self, batch):
@@ -285,8 +314,11 @@ class CompiledTrainStep:
                 return jax.value_and_grad(pure_loss)(params)
 
             self._grad_fn = jax.jit(gstep)
+            _insp.get_compile_watch().register_program("train.grad_step")
         self._key, sub = jax.random.split(self._key)
-        return self._grad_fn(self.state["params"], _to_arrays(batch), sub)
+        return _insp.watched_call("train.grad_step", self._grad_fn,
+                                  self.state["params"],
+                                  _to_arrays(batch), sub)
 
     def apply_grads(self, grads):
         """Optimizer update from externally-computed (accumulated) grads."""
@@ -302,8 +334,10 @@ class CompiledTrainStep:
             # accumulation path holds params+opt twice at the update
             self._apply_fn = jax.jit(
                 apply, donate_argnums=(0,) if self._donate else ())
-        self.state = self._apply_fn(self.state, grads,
-                                    self.optimizer.get_lr())
+            _insp.get_compile_watch().register_program("train.apply_grads")
+        self.state = _insp.watched_call(
+            "train.apply_grads", self._apply_fn, self.state, grads,
+            self.optimizer.get_lr())
         self._step_count += 1
         sched = self.optimizer._lr_scheduler
         if sched is not None:

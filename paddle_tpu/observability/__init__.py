@@ -24,6 +24,17 @@ Three pieces, all stdlib-only:
   merge helpers ``ReplicaRouter.fleet_snapshot()`` federates with.
   Same disabled-is-free contract: ``get_health()`` returns the shared
   ``NULL_HEALTH`` singleton when the plane is off.
+- :mod:`~paddle_tpu.observability.introspection` — the compile &
+  memory plane: ``CompileWatch`` (structured compile records +
+  recompile sentinel over every jit entry point — the one-compile
+  invariant as a runtime guarantee), device-memory watermarks with
+  the paged KV pool / host swap pool / checkpoint staging as
+  first-class rows, and per-program cost attribution, served as
+  ``GET /compilez`` / ``GET /memz`` and federated through
+  ``/fleetz``.  Same disabled-is-free contract:
+  ``get_compile_watch()`` returns the shared ``NULL_COMPILE_WATCH``
+  singleton, and ``watched_call`` tail-calls the jit function off one
+  module-global read.
 
 Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
 gauges, compile-count gauges) lives with the instrumented code in
@@ -48,7 +59,12 @@ from .health import (SLO, AnomalySentinel, GoodputMeter, HealthHub,
                      SlidingWindow, SLOTracker, disable_health,
                      enable_health, get_health, goodput_region,
                      merge_histogram_snapshots)
+from .introspection import (CompileWatch, RecompileError,
+                            disable_compile_watch, enable_compile_watch,
+                            get_compile_watch, register_memory_consumer,
+                            watched_call)
 from . import health
+from . import introspection
 from . import tracing
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
@@ -60,4 +76,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
            "SlidingWindow", "SLO", "SLOTracker", "GoodputMeter",
            "AnomalySentinel", "HealthHub", "enable_health",
            "disable_health", "get_health", "goodput_region",
-           "merge_histogram_snapshots", "health"]
+           "merge_histogram_snapshots", "health", "CompileWatch",
+           "RecompileError", "enable_compile_watch",
+           "disable_compile_watch", "get_compile_watch",
+           "watched_call", "register_memory_consumer",
+           "introspection"]

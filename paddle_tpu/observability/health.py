@@ -452,6 +452,7 @@ class GoodputMeter:
         self._t_start: Optional[float] = None
         self._t_stop: Optional[float] = None
         self._seconds: Dict[str, float] = {}
+        self._attr: Dict[str, Dict[str, float]] = {}
 
     def start(self):
         """Open (or reopen) the accounting window, zeroing buckets."""
@@ -460,6 +461,7 @@ class GoodputMeter:
             self._t_stop = None
             self._seconds = {b: 0.0 for b in GOODPUT_BUCKETS
                              if b != "other"}
+            self._attr = {}
 
     def stop(self):
         with self._lock:
@@ -478,18 +480,32 @@ class GoodputMeter:
             self._seconds[bucket] = \
                 self._seconds.get(bucket, 0.0) + max(0.0, seconds)
 
+    def attribute(self, bucket: str, key: str, seconds: float):
+        """Named sub-accounting WITHIN a bucket — the CompileWatch
+        attributes the ``compile`` bucket per program name, so badput
+        names its culprit instead of reporting one opaque total.  This
+        is a parallel view: it never changes the bucket seconds the
+        regions book (fractions still sum to 1.0)."""
+        with self._lock:
+            if self._t_start is None:
+                return
+            d = self._attr.setdefault(bucket, {})
+            d[key] = d.get(key, 0.0) + max(0.0, seconds)
+
     def report(self) -> dict:
         """{total_seconds, seconds{bucket}, fractions{bucket},
-        goodput} — fractions sum to 1.0 (the ``other`` remainder
-        absorbs unattributed wall time)."""
+        goodput, attribution{bucket}{key}} — fractions sum to 1.0
+        (the ``other`` remainder absorbs unattributed wall time)."""
         with self._lock:
             if self._t_start is None:
                 return {"running": False, "total_seconds": 0.0,
-                        "seconds": {}, "fractions": {}, "goodput": None}
+                        "seconds": {}, "fractions": {},
+                        "attribution": {}, "goodput": None}
             end = self._t_stop if self._t_stop is not None \
                 else self._clock()
             wall = max(0.0, end - self._t_start)
             seconds = dict(self._seconds)
+            attribution = {b: dict(d) for b, d in self._attr.items()}
         tracked = sum(seconds.values())
         seconds["other"] = max(0.0, wall - tracked)
         denom = tracked + seconds["other"]
@@ -497,7 +513,7 @@ class GoodputMeter:
                      for b in GOODPUT_BUCKETS}
         return {"running": self._t_stop is None,
                 "total_seconds": wall, "seconds": seconds,
-                "fractions": fractions,
+                "fractions": fractions, "attribution": attribution,
                 "goodput": fractions["productive_step"]}
 
 
@@ -620,12 +636,16 @@ class _NullGoodput:
     def add(self, bucket, seconds):
         pass
 
+    def attribute(self, bucket, key, seconds):
+        pass
+
     def region(self, bucket):
         return NULL_REGION
 
     def report(self):
         return {"running": False, "total_seconds": 0.0,
-                "seconds": {}, "fractions": {}, "goodput": None}
+                "seconds": {}, "fractions": {}, "attribution": {},
+                "goodput": None}
 
 
 NULL_GOODPUT = _NullGoodput()

@@ -1,0 +1,645 @@
+"""Compile & memory introspection plane: the recompile sentinel, HBM
+watermarks, and per-program cost attribution behind ``GET /compilez``
+and ``GET /memz``.
+
+The whole stack is built on one invariant — ONE compiled program per
+shape family (``prefill_compiles() == 1``, ``mixed_compiles() == 1``,
+``step_compiles()`` one-program) — but until this module it was only
+asserted in tests.  In production a silent recompile storm (a shape
+leaking into a trace) or HBM creep is invisible until latency or OOM
+makes it an incident.  This module makes the invariant a RUNTIME
+guarantee:
+
+* ``CompileWatch`` — a process-global watch every jit entry point
+  registers with (engine prefill/decode/mixed programs,
+  ``CompiledTrainStep``/``ShardedTrainStep`` and their grad/apply/eval
+  programs, the Pallas fused-train dispatch).  Each compilation event
+  lands as a structured record: program name, abstract arg
+  shape/dtype signature, compile wall time, ``cost_analysis()``
+  FLOPs/bytes-accessed, per-program memory estimate from the lowered
+  computation, and the triggering call site.
+* the **recompile sentinel** — after a program's registered warmup
+  allowance (1 unless the entry point declares more, e.g. the split
+  decode program's power-of-two window buckets), any further compile
+  of the same program name is an anomaly: warn →
+  ``record_event("recompile")`` + flight-recorder ``dump_once``, or
+  raise ``RecompileError`` under the ``"raise"``/``"halt"`` policy
+  (tests pin the exactly-one-event contract).
+* the **memory plane** — live device-memory watermarks
+  (``device.memory_stats()`` where the backend provides it; CPU CI
+  does not), with the paged KV pool, host swap pool, and checkpoint
+  staging accounted as first-class rows via the consumer registry
+  (``register_memory_consumer`` holds WEAK references — a released
+  engine's pool must not be pinned by its telemetry), plus
+  peak-tracking gauges feeding the registry.
+
+Disabled is free — the same STRICT contract as tracing.py/health.py:
+``watched_call`` reads ONE module global and tail-calls the jit
+function when the watch is off; ``get_compile_watch()`` returns the
+shared ``NULL_COMPILE_WATCH`` singleton (identity-asserted in
+tests/test_introspection.py).  With the watch ON, arguments pass
+through untouched (tokens bit-identical) and compile DETECTION reads
+the jit cache size around the dispatch — the AOT ``lower()`` used for
+cost analysis never populates the dispatch cache, so the one-compile
+counters are unchanged too.
+
+Federation: ``Scheduler.metrics_snapshot()`` carries a brief
+``introspection`` table (and ``memory`` rows) when the watch is on, so
+``ReplicaRouter.fleet_snapshot()`` / ``GET /fleetz`` sum compile and
+recompile counts across in-process and remote replicas exactly like
+the health plane's counters.  ``GoodputMeter``'s ``compile`` bucket is
+attributed per program on every recorded compile, so badput names its
+culprit.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import traceback
+import warnings
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..common.errors import enforce
+from . import tracing as _tracing
+from .metrics import get_registry
+
+__all__ = [
+    "CompileWatch", "RecompileError", "NULL_COMPILE_WATCH",
+    "enable_compile_watch", "disable_compile_watch",
+    "get_compile_watch", "watched_call", "abstract_signature",
+    "register_memory_consumer", "memory_consumers",
+    "device_memory_rows", "compilez_snapshot", "memz_snapshot",
+]
+
+
+class RecompileError(RuntimeError):
+    """A warm program compiled again under the ``raise`` policy —
+    a shape/dtype leaked into a trace that must stay one-program."""
+
+
+# -- abstract signatures ------------------------------------------------------
+
+_DTYPE_SHORT = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "int64": "i64", "int32": "i32",
+    "int16": "i16", "int8": "i8", "uint32": "u32", "uint8": "u8",
+    "bool": "b1",
+}
+
+
+def _leaf_sig(x) -> str:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        name = str(getattr(dtype, "name", dtype))
+        short = _DTYPE_SHORT.get(name, name)
+        return f"{short}[{','.join(str(int(d)) for d in shape)}]"
+    if isinstance(x, (bool, int, float, str)):
+        return repr(x)
+    return type(x).__name__
+
+
+def abstract_signature(args: tuple, kwargs: dict,
+                       limit: int = 2048) -> str:
+    """The program's abstract calling convention: one ``dtype[shape]``
+    token per array leaf (``.shape``/``.dtype`` read the AVAL, which
+    survives donation — safe even after the dispatch consumed the
+    buffers), static scalars/strings verbatim.  This is the string the
+    recompile post-mortem diffs against the warmup record to name the
+    leaked dimension."""
+    import jax
+    parts = [_leaf_sig(leaf) for leaf in jax.tree_util.tree_leaves(args)]
+    for k in sorted(kwargs):
+        parts.append(f"{k}={_leaf_sig(kwargs[k])}")
+    sig = ",".join(parts)
+    return sig if len(sig) <= limit else sig[:limit - 3] + "..."
+
+
+def _leaf_bytes(x) -> int:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(math.prod(shape)) * int(getattr(dtype, "itemsize", 0)
+                                           or 0)
+    except Exception:
+        return 0
+
+
+def _call_site() -> Optional[str]:
+    """The innermost stack frame OUTSIDE this module — the dispatch
+    site whose call triggered the compile."""
+    try:
+        here = os.path.basename(__file__)
+        for fr in reversed(traceback.extract_stack()):
+            base = os.path.basename(fr.filename or "")
+            if base != here:
+                return f"{base}:{fr.lineno} ({fr.name})"
+    except Exception:
+        pass
+    return None
+
+
+def _cache_size(jitfn) -> Optional[int]:
+    try:
+        return int(jitfn._cache_size())
+    except Exception:
+        return None                     # not a jit fn we can introspect
+
+
+def _lowered_analysis(jitfn, args, kwargs
+                      ) -> Tuple[Optional[dict], Optional[dict]]:
+    """Best-effort ``(cost, memory)`` from an AOT lowering of the same
+    call.  Lowering only reads avals (donation-safe) and never touches
+    the dispatch cache, so the one-compile counters stay honest; any
+    backend that can't answer simply yields ``None`` fields."""
+    import jax
+    try:
+        lowered = jitfn.lower(*args, **kwargs)
+    except Exception:
+        return None, None
+    cost = None
+    try:
+        c = lowered.cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0] if c else {}
+        if isinstance(c, dict):
+            cost = {"flops": float(c.get("flops", -1.0)),
+                    "bytes_accessed": float(c.get("bytes accessed",
+                                                  -1.0))}
+    except Exception:
+        pass
+    memory = {"arg_bytes": sum(_leaf_bytes(leaf) for leaf in
+                               jax.tree_util.tree_leaves(args))}
+    try:
+        out_info = lowered.out_info
+        memory["out_bytes"] = sum(
+            _leaf_bytes(leaf) for leaf in
+            jax.tree_util.tree_leaves(out_info))
+    except Exception:
+        pass
+    if cost is not None and cost.get("bytes_accessed", -1.0) > 0:
+        memory["bytes_accessed"] = cost["bytes_accessed"]
+    return cost, memory
+
+
+# -- the watch ----------------------------------------------------------------
+
+class CompileWatch:
+    """The enabled plane.  Thread-safe; one instance process-global
+    via ``enable_compile_watch()``.  ``on_recompile`` picks the
+    sentinel policy: ``"warn"`` (default — python warning + structured
+    ``recompile`` event + flight-recorder dump) or ``"raise"`` /
+    ``"halt"`` (tests: the injected shape leak must explode, not
+    scroll by)."""
+
+    POLICIES = ("warn", "raise", "halt")
+    enabled = True
+
+    def __init__(self, on_recompile: str = "warn",
+                 log_limit: int = 256, enable_metrics: bool = True,
+                 clock: Optional[Callable[[], float]] = None):
+        enforce(on_recompile in self.POLICIES,
+                f"on_recompile {on_recompile!r} not in {self.POLICIES}")
+        self.on_recompile = on_recompile
+        self._clock = clock or time.perf_counter
+        self._lock = threading.Lock()
+        # program -> {compiles, recompiles, allowed, seconds, last}
+        self._programs: Dict[str, dict] = {}
+        self._log: deque = deque(maxlen=int(log_limit))
+        self._recompiles: List[dict] = []
+        self._subprograms: Dict[str, dict] = {}
+        self._peaks: Dict[str, int] = {}      # device -> peak bytes seen
+        self._metrics = None
+        if enable_metrics:
+            reg = get_registry()
+            self._metrics = {
+                "compiles": reg.counter(
+                    "jit_compile_events_total",
+                    "Compilation events the CompileWatch observed, "
+                    "by program name.", ("program",)),
+                "recompiles": reg.counter(
+                    "jit_recompile_events_total",
+                    "Recompiles past the program's warmup allowance "
+                    "— each one is a shape/dtype leak.", ("program",)),
+                "seconds": reg.counter(
+                    "jit_compile_seconds_total",
+                    "Wall time spent in observed compiles (includes "
+                    "the triggering call's first run).", ("program",)),
+                "peak": reg.gauge(
+                    "device_memory_peak_bytes",
+                    "Peak device bytes-in-use the memory plane has "
+                    "seen (watermark; backends without memory_stats "
+                    "render nothing).", ("device",)),
+                "pool": reg.gauge(
+                    "memory_pool_bytes",
+                    "Bytes held by a first-class memory pool (paged "
+                    "KV, host swap, checkpoint staging).", ("pool",)),
+            }
+
+    # -- registration ---------------------------------------------------------
+    def register_program(self, program: str, expected: int = 1):
+        """Declare a jit entry point: ``expected`` more compiles of
+        ``program`` are warmup, not anomalies.  Engines register their
+        three programs at construction (the split decode program
+        declares its power-of-two window buckets); train steps
+        register each jit they build.  Allowances accumulate across
+        instances — two engines sharing one process may each warm the
+        cache once."""
+        with self._lock:
+            st = self._program_locked(program)
+            st["allowed"] += max(0, int(expected))
+
+    def _program_locked(self, program: str) -> dict:
+        st = self._programs.get(program)
+        if st is None:
+            st = {"compiles": 0, "recompiles": 0, "allowed": 0,
+                  "seconds": 0.0, "last": None}
+            self._programs[program] = st
+        return st
+
+    def note_subprogram(self, name: str, **meta):
+        """A traced sub-region (the Pallas fused-train dispatch)
+        registering from INSIDE a jit trace: it has no executable of
+        its own, but the note ties the kernel region to whichever
+        program is compiling right now — recorded once per name."""
+        with self._lock:
+            if name in self._subprograms:
+                self._subprograms[name]["traces"] += 1
+                return
+            self._subprograms[name] = dict(meta, traces=1)
+        self._append_log({"kind": "subprogram", "program": name,
+                          **{k: v for k, v in meta.items()}})
+
+    def _append_log(self, rec: dict):
+        with self._lock:
+            self._log.append(rec)
+
+    # -- the dispatch wrapper -------------------------------------------------
+    def call(self, program: str, jitfn, args: tuple, kwargs: dict):
+        """Run one dispatch, detecting a compile as jit-cache growth
+        around it.  The arguments pass through UNTOUCHED (tokens stay
+        bit-identical); signature/cost work happens only when a
+        compile was actually observed."""
+        n0 = _cache_size(jitfn)
+        t0 = self._clock()
+        out = jitfn(*args, **kwargs)
+        if n0 is not None:
+            n1 = _cache_size(jitfn)
+            if n1 is not None and n1 > n0:
+                dt = self._clock() - t0
+                self.record_compile(
+                    program, signature=abstract_signature(args, kwargs),
+                    seconds=dt, jitfn=jitfn, args=args, kwargs=kwargs)
+        return out
+
+    def record_compile(self, program: str,
+                       signature: Optional[str] = None,
+                       seconds: float = 0.0, cost: Optional[dict] = None,
+                       memory: Optional[dict] = None,
+                       call_site: Optional[str] = None,
+                       jitfn=None, args: tuple = (),
+                       kwargs: Optional[dict] = None):
+        """One structured compilation event.  When the raw ``jitfn``/
+        args are passed (the ``call`` path), cost and memory come from
+        an AOT lowering of the same call.  Past the program's warmup
+        allowance this is a RECOMPILE: one structured ``recompile``
+        flight-recorder event + ``dump_once`` per event, a python
+        warning under ``warn``, ``RecompileError`` under
+        ``raise``/``halt``."""
+        if cost is None and jitfn is not None:
+            cost, memory = _lowered_analysis(jitfn, args, kwargs or {})
+        site = call_site if call_site is not None else _call_site()
+        rec = {"kind": "compile", "program": program,
+               "signature": signature, "seconds": round(seconds, 6),
+               "cost": cost, "memory": memory, "call_site": site}
+        with self._lock:
+            st = self._program_locked(program)
+            st["compiles"] += 1
+            st["seconds"] += seconds
+            st["last"] = {k: rec[k] for k in
+                          ("signature", "seconds", "cost", "memory",
+                           "call_site")}
+            is_recompile = st["compiles"] > max(1, st["allowed"])
+            if is_recompile:
+                st["recompiles"] += 1
+                n_recompiles = st["recompiles"]
+            self._log.append(rec)
+        if self._metrics is not None:
+            self._metrics["compiles"].labels(program).inc()
+            self._metrics["seconds"].labels(program).inc(
+                max(0.0, seconds))
+        # per-program attribution of the goodput compile bucket —
+        # badput names its culprit (a no-op when health is off or no
+        # accounting run is open)
+        from . import health as _health
+        _health.get_health().goodput.attribute(
+            "compile", program, seconds)
+        if not is_recompile:
+            return
+        event = {"program": program, "signature": signature,
+                 "seconds": round(seconds, 6), "call_site": site,
+                 "n": n_recompiles}
+        with self._lock:
+            self._recompiles.append(event)
+        if self._metrics is not None:
+            self._metrics["recompiles"].labels(program).inc()
+        _tracing.record_event("recompile", **event)
+        fr = _tracing.get_flight_recorder()
+        if fr is not None:
+            try:
+                # once per (program, ordinal): every injected leak
+                # produces exactly one dump, repeats of the SAME storm
+                # don't spam the disk
+                fr.dump_once(f"recompile:{program}:{n_recompiles}")
+            except Exception:
+                pass
+        msg = (f"recompile of warm program {program!r} "
+               f"(signature {signature!r}, call site {site}) — a "
+               f"shape/dtype leaked into a one-program trace")
+        if self.on_recompile in ("raise", "halt"):
+            raise RecompileError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    # -- memory watermarks ----------------------------------------------------
+    def track_devices(self, rows: List[dict]) -> List[dict]:
+        """Fold live device rows into the peak watermarks and publish
+        the gauges; returns the rows annotated with the tracked
+        peak."""
+        with self._lock:
+            for row in rows:
+                dev = str(row.get("device"))
+                cur = int(row.get("bytes_in_use") or 0)
+                reported_peak = int(row.get("peak_bytes_in_use") or 0)
+                peak = max(self._peaks.get(dev, 0), cur, reported_peak)
+                self._peaks[dev] = peak
+                row["tracked_peak_bytes"] = peak
+        if self._metrics is not None:
+            for row in rows:
+                self._metrics["peak"].labels(str(row["device"])).set(
+                    float(row["tracked_peak_bytes"]))
+        return rows
+
+    def set_pool_gauge(self, pool: str, nbytes: float):
+        if self._metrics is not None:
+            self._metrics["pool"].labels(pool).set(float(nbytes))
+
+    # -- reads ----------------------------------------------------------------
+    def program_memory(self) -> Dict[str, dict]:
+        """Per-program memory estimates from the last recorded
+        lowering — the ``/memz`` top-consumers companion table."""
+        with self._lock:
+            return {name: dict(st["last"]["memory"])
+                    for name, st in self._programs.items()
+                    if st["last"] and st["last"].get("memory")}
+
+    def snapshot(self, include_log: bool = True) -> dict:
+        """JSON-able ``/compilez`` payload: the per-program table
+        (compiles vs allowance, recompiles, cumulative seconds, last
+        record), the recompile event list, traced subprograms, and —
+        unless ``include_log=False`` (the federation scrape rides a
+        brief table) — the bounded compile log."""
+        with self._lock:
+            programs = {
+                name: {"compiles": st["compiles"],
+                       "recompiles": st["recompiles"],
+                       "allowed": max(1, st["allowed"]),
+                       "compile_seconds": round(st["seconds"], 6),
+                       "last": st["last"]}
+                for name, st in sorted(self._programs.items())}
+            out = {"enabled": True, "policy": self.on_recompile,
+                   "programs": programs,
+                   "recompiles": list(self._recompiles),
+                   "subprograms": {k: dict(v) for k, v in
+                                   self._subprograms.items()}}
+            if include_log:
+                out["log"] = list(self._log)
+        return out
+
+
+# -- disabled-is-free plumbing ------------------------------------------------
+
+class _NullCompileWatch:
+    """The disabled plane: one shared instance, every method a no-op —
+    instrumentation sites cost one global read."""
+
+    __slots__ = ()
+
+    enabled = False
+
+    def register_program(self, program, expected=1):
+        pass
+
+    def note_subprogram(self, name, **meta):
+        pass
+
+    def record_compile(self, program, **kw):
+        pass
+
+    def call(self, program, jitfn, args, kwargs):
+        return jitfn(*args, **kwargs)
+
+    def snapshot(self, include_log=True):
+        return {"enabled": False}
+
+
+NULL_COMPILE_WATCH = _NullCompileWatch()
+
+_WATCH: Optional[CompileWatch] = None
+
+
+def enable_compile_watch(**kw) -> CompileWatch:
+    """Install the process-global CompileWatch (see the class for the
+    knobs).  Replaces any previous watch — counts restart from zero,
+    and programs already warm in the process-global jit caches simply
+    never produce a cache-growth event (enable-on-a-live-server is
+    safe)."""
+    global _WATCH
+    _WATCH = CompileWatch(**kw)
+    return _WATCH
+
+
+def disable_compile_watch() -> None:
+    global _WATCH
+    _WATCH = None
+
+
+def get_compile_watch():
+    """The active watch, or the shared ``NULL_COMPILE_WATCH``
+    singleton — the one-global-read contract every instrumentation
+    site relies on."""
+    w = _WATCH
+    return w if w is not None else NULL_COMPILE_WATCH
+
+
+def watched_call(program: str, jitfn, *args, **kwargs):
+    """THE dispatch wrapper: replace ``jitfn(*a, **kw)`` with
+    ``watched_call("name", jitfn, *a, **kw)`` at every jit entry
+    point.  Off: one module-global read, then the jit call untouched.
+    On: the same call plus jit-cache-growth compile detection."""
+    w = _WATCH
+    if w is None:
+        return jitfn(*args, **kwargs)
+    return w.call(program, jitfn, args, kwargs)
+
+
+# -- the memory plane ---------------------------------------------------------
+
+# name -> weakref to an object with memory_rows() -> dict (must carry
+# "device_bytes" and "host_bytes"); registration is construction-time
+# (never a hot path) and unconditional so a watch enabled mid-flight
+# still sees every live pool
+_CONSUMERS: Dict[str, "weakref.ref"] = {}
+_CONSUMERS_LOCK = threading.Lock()
+
+
+def register_memory_consumer(name: str, obj) -> None:
+    """Register a live memory pool for ``/memz``.  Weakly held: when
+    the owner is collected the row vanishes instead of pinning device
+    buffers.  Re-registering a name replaces the old ref (engine ids
+    recycle across tests)."""
+    enforce(hasattr(obj, "memory_rows"),
+            f"memory consumer {name!r} must expose memory_rows()")
+    with _CONSUMERS_LOCK:
+        _CONSUMERS[name] = weakref.ref(obj)
+
+
+def memory_consumers() -> Dict[str, dict]:
+    """Live consumer rows; dead refs are pruned on read."""
+    out: Dict[str, dict] = {}
+    with _CONSUMERS_LOCK:
+        items = list(_CONSUMERS.items())
+    dead = []
+    for name, ref in items:
+        obj = ref()
+        if obj is None:
+            dead.append(name)
+            continue
+        try:
+            out[name] = dict(obj.memory_rows())
+        except Exception as e:
+            out[name] = {"error": str(e), "device_bytes": 0,
+                         "host_bytes": 0}
+    if dead:
+        with _CONSUMERS_LOCK:
+            for name in dead:
+                if name in _CONSUMERS and _CONSUMERS[name]() is None:
+                    del _CONSUMERS[name]
+    return out
+
+
+def device_memory_rows() -> List[dict]:
+    """One row per local device from ``device.memory_stats()`` —
+    present on TPU/GPU backends, absent on CPU (the accounted consumer
+    rows are then the whole story)."""
+    rows: List[dict] = []
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                st = d.memory_stats()
+            except Exception:
+                st = None
+            if not st:
+                continue
+            rows.append({
+                "device": str(d),
+                "bytes_in_use": st.get("bytes_in_use"),
+                "peak_bytes_in_use": st.get("peak_bytes_in_use"),
+                "bytes_limit": st.get("bytes_limit"),
+            })
+    except Exception:
+        pass
+    return rows
+
+
+def _staging_row(walk: bool = True) -> dict:
+    """Checkpoint staging as a first-class row: live ``*.tmp-<nonce>``
+    dirs (an in-flight or torn save) and their on-disk bytes."""
+    try:
+        from ..distributed import checkpoint as dck
+        dirs = dck.staging_dirs_alive()
+    except Exception:
+        return {"dirs": 0, "bytes": 0}
+    total = 0
+    if walk:
+        for d in dirs:
+            try:
+                for root, _, files in os.walk(d):
+                    for f in files:
+                        try:
+                            total += os.path.getsize(
+                                os.path.join(root, f))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+    return {"dirs": len(dirs), "bytes": total}
+
+
+def memory_brief() -> dict:
+    """The federation-sized memory view that rides in
+    ``Scheduler.metrics_snapshot()["memory"]``: per-pool byte totals
+    and live device rows, NO filesystem walks (scrapes are frequent)."""
+    consumers = memory_consumers()
+    device_pool = sum(int(r.get("device_bytes") or 0)
+                      for r in consumers.values())
+    host_pool = sum(int(r.get("host_bytes") or 0)
+                    for r in consumers.values())
+    out = {"device_pool_bytes": device_pool,
+           "host_pool_bytes": host_pool,
+           "checkpoint_staging": _staging_row(walk=False)}
+    devices = device_memory_rows()
+    w = _WATCH
+    if w is not None:
+        devices = w.track_devices(devices)
+        w.set_pool_gauge("kv_pool", device_pool)
+        w.set_pool_gauge("host_swap", host_pool)
+    if devices:
+        out["devices"] = devices
+    return out
+
+
+def memz_snapshot() -> dict:
+    """The full ``GET /memz`` payload: device watermarks, every
+    accounted consumer's rows, checkpoint staging (with on-disk
+    bytes), top consumers by total footprint, and — with the watch
+    on — per-program memory estimates from lowered cost analysis."""
+    consumers = memory_consumers()
+    staging = _staging_row(walk=True)
+    devices = device_memory_rows()
+    w = _WATCH
+    if w is not None:
+        devices = w.track_devices(devices)
+    totals = {name: int(r.get("device_bytes") or 0) +
+              int(r.get("host_bytes") or 0)
+              for name, r in consumers.items()}
+    totals["checkpoint_staging"] = staging["bytes"]
+    top = sorted(totals.items(), key=lambda t: -t[1])
+    out = {"watch_enabled": w is not None,
+           "devices": devices,
+           "consumers": consumers,
+           "checkpoint_staging": staging,
+           "top_consumers": [{"name": n, "bytes": b} for n, b in top]}
+    if w is not None:
+        w.set_pool_gauge("kv_pool", sum(
+            int(r.get("device_bytes") or 0) for r in consumers.values()))
+        w.set_pool_gauge("host_swap", sum(
+            int(r.get("host_bytes") or 0) for r in consumers.values()))
+        w.set_pool_gauge("ckpt_staging", staging["bytes"])
+        out["per_program"] = w.program_memory()
+    return out
+
+
+def compilez_snapshot() -> dict:
+    """The full ``GET /compilez`` payload (``{"enabled": False}`` when
+    the watch is off — the endpoint always answers)."""
+    w = _WATCH
+    if w is None:
+        return {"enabled": False}
+    return w.snapshot(include_log=True)

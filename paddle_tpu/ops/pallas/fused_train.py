@@ -217,6 +217,13 @@ def fused_update_flat(kind, p, g, slots, *, lr, step_f, clip_scale, hyper):
     shape (Optimizer.apply_gradients_fused packs the small-leaf tail
     into flat per-dtype buffers before calling this).  Kernel on TPU,
     bit-identical jnp reference elsewhere."""
+    from ...observability import introspection as _insp
+    # runs at TRACE time (inside the enclosing step's jit), i.e.
+    # exactly when the surrounding program compiles — which is what a
+    # subprogram note should count
+    _insp.get_compile_watch().note_subprogram(
+        "pallas.fused_update_flat", kind=kind,
+        kernel=bool(kernels_active()))
     if kernels_active():
         try:
             return _fused_update_kernel(kind, p, g, slots, lr=lr,

@@ -57,6 +57,7 @@ from typing import Callable, Dict, List, Optional
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
 from ..observability import health as _health
+from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 from ..observability.tracing import record_event
 from .scheduler import RejectedError
@@ -704,10 +705,44 @@ class ReplicaRouter:
             merged = _health.merge_histogram_snapshots(parts)
             if merged is not None:
                 fleet[name] = merged
+        # compile-plane federation: sum each replica's per-program
+        # compile/recompile counts and compile seconds (a recompile
+        # storm anywhere in the fleet shows up in ONE table)
+        compile_fleet: Dict[str, dict] = {}
+        for s in fresh:
+            progs = (s.get("introspection") or {}).get("programs") or {}
+            for name, st in progs.items():
+                agg = compile_fleet.setdefault(
+                    name, {"compiles": 0, "recompiles": 0,
+                           "compile_seconds": 0.0})
+                agg["compiles"] += int(st.get("compiles", 0) or 0)
+                agg["recompiles"] += int(st.get("recompiles", 0) or 0)
+                agg["compile_seconds"] += float(
+                    st.get("compile_seconds", 0.0) or 0.0)
+        if compile_fleet:
+            fleet["compile"] = {
+                name: dict(st, compile_seconds=round(
+                    st["compile_seconds"], 6))
+                for name, st in sorted(compile_fleet.items())}
+        # memory-plane federation: pool bytes sum across replicas
+        mems = [s.get("memory") for s in fresh if s.get("memory")]
+        if mems:
+            fleet["memory"] = {
+                "device_pool_bytes": sum(
+                    int(m.get("device_pool_bytes") or 0) for m in mems),
+                "host_pool_bytes": sum(
+                    int(m.get("host_pool_bytes") or 0) for m in mems),
+                "checkpoint_staging_dirs": sum(
+                    int((m.get("checkpoint_staging") or {})
+                        .get("dirs") or 0) for m in mems),
+            }
         out = {"router": self.router_id, "retries": self.retry_count,
                "ejected": sorted(self._ejected),
                "replicas": rows, "fleet": fleet}
         h = _health.get_health()
         if h.enabled:
             out["health"] = h.snapshot()
+        cw = _insp.get_compile_watch()
+        if cw.enabled:
+            out["introspection"] = cw.snapshot(include_log=False)
         return out

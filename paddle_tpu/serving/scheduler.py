@@ -70,6 +70,7 @@ from typing import Callable, Dict, List, Optional
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
 from ..observability import health as _health
+from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 
 __all__ = ["Scheduler", "RejectedError", "ScheduledRequest"]
@@ -825,6 +826,13 @@ class Scheduler:
         h = _health.get_health()
         if h.enabled:
             snap["health"] = h.snapshot()
+        # compile & memory plane rides the same scrape when the watch
+        # is on: the brief per-program table (no log) + pool byte
+        # totals, which fleet_snapshot() sums across replicas
+        cw = _insp.get_compile_watch()
+        if cw.enabled:
+            snap["introspection"] = cw.snapshot(include_log=False)
+            snap["memory"] = _insp.memory_brief()
         return snap
 
     # -- internals (lock held) -------------------------------------------------

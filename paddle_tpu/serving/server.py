@@ -73,6 +73,7 @@ from typing import Optional
 from ..common.errors import EnforceError, UnavailableError
 from ..observability import get_registry
 from ..observability import health as _health
+from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 from ..observability.exposition import CONTENT_TYPE as _PROM_CONTENT_TYPE
 from .scheduler import RejectedError
@@ -188,6 +189,10 @@ class HTTPFrontend:
                         self, frontend.target.metrics_snapshot)
                 elif path == "/fleetz":
                     frontend._guarded(self, frontend._fleetz)
+                elif path == "/compilez":
+                    frontend._guarded(self, frontend._compilez)
+                elif path == "/memz":
+                    frontend._guarded(self, frontend._memz)
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
@@ -464,7 +469,23 @@ class HTTPFrontend:
         h = _health.get_health()
         if h.enabled:
             out["health"] = h.snapshot()
+        cw = _insp.get_compile_watch()
+        if cw.enabled:
+            out["introspection"] = cw.snapshot(include_log=False)
         return out
+
+    def _compilez(self) -> dict:
+        """Compile log + per-program table from the CompileWatch
+        (``{"enabled": false}`` when the plane is off — the endpoint
+        always answers, like /tracez)."""
+        return _insp.compilez_snapshot()
+
+    def _memz(self) -> dict:
+        """Memory plane: device watermarks, accounted pool rows (paged
+        KV, host swap, checkpoint staging), top consumers, and — watch
+        on — per-program memory estimates from lowered cost
+        analysis."""
+        return _insp.memz_snapshot()
 
     def _tracez(self, query: str) -> dict:
         """Recent slow traces: every trace whose wall extent exceeds

@@ -463,6 +463,17 @@ class RemoteReplica:
         return self._call("poll", "GET", "/v1/metrics_snapshot",
                           retries=0, timeout=min(self.timeout, 2.0))
 
+    def compilez(self) -> dict:
+        """The backend's compile-plane page (``GET /compilez``):
+        per-program table + bounded compile log from its
+        CompileWatch."""
+        return self._call("poll", "GET", "/compilez")
+
+    def memz(self) -> dict:
+        """The backend's memory-plane page (``GET /memz``): device
+        watermarks, accounted pool rows, top consumers."""
+        return self._call("poll", "GET", "/memz")
+
     def request_timeline(self, rid) -> dict:
         """The backend's per-request timing breakdown
         (``POST /v1/timeline``) — timestamps are the BACKEND's

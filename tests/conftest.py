@@ -9,7 +9,11 @@ test (a leaked loop thread is
 how a tier-1 run hangs on a 1-core box); and a staging-dir guard fails
 any test that leaves ``*.tmp-<nonce>`` checkpoint staging dirs behind
 (an un-swept torn save — call ``CheckpointManager.gc_stale()`` or do a
-recovery save before returning)."""
+recovery save before returning).  The CompileWatch global is likewise
+reset after every test (mirroring the tracer/health guards inside
+observability tests): a watch left enabled would count every later
+test's compiles against ITS warmup allowances and trip the recompile
+sentinel on innocent tests."""
 import threading
 import time
 
@@ -54,6 +58,19 @@ def _no_thread_leaks():
         f"{[(t.name, 'daemon' if t.daemon else 'non-daemon') for t in left]} "
         f"— shut down frontends/probers (fe.shutdown(), prober.stop()) "
         f"before returning")
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_watch():
+    """Disable the process-global CompileWatch after every test — the
+    same guard the tracing/health planes get inside their own test
+    files, but process-global here because EVERY test that builds an
+    engine or train step registers programs with whatever watch is
+    live.  Without this, one test's enabled watch inherits the next
+    test's compiles and its sentinel assertions become order-dependent."""
+    yield
+    from paddle_tpu.observability import introspection as _insp
+    _insp.disable_compile_watch()
 
 
 @pytest.fixture(autouse=True)

@@ -54,6 +54,32 @@ def _golden_registry() -> MetricRegistry:
                   labelnames=("action",))
     a.labels("mark_slow").inc(2)
     a.labels("drain").inc()
+    # the compile & memory introspection plane's families (PR 12):
+    # compile/recompile counts, compile wall time, HBM watermarks,
+    # first-class pool bytes
+    jc = r.counter("jit_compile_events_total",
+                   "Compilation events the CompileWatch observed.",
+                   labelnames=("program",))
+    jc.labels("engine.prefill_chunk").inc()
+    jc.labels("engine.mixed_step").inc(2)
+    jr = r.counter("jit_recompile_events_total",
+                   "Recompiles past the warmup allowance.",
+                   labelnames=("program",))
+    jr.labels("engine.mixed_step").inc()
+    js = r.counter("jit_compile_seconds_total",
+                   "Wall time spent in observed compiles.",
+                   labelnames=("program",))
+    js.labels("engine.mixed_step").inc(1.5)
+    pk = r.gauge("device_memory_peak_bytes",
+                 "Peak device bytes-in-use the memory plane has seen.",
+                 labelnames=("device",))
+    pk.labels("TPU_0").set(2147483648)
+    pool = r.gauge("memory_pool_bytes",
+                   "Bytes held by a first-class memory pool.",
+                   labelnames=("pool",))
+    pool.labels("kv_pool").set(69632)
+    pool.labels("host_swap").set(0)
+    pool.labels("ckpt_staging").set(4096)
     return r
 
 
