@@ -586,12 +586,71 @@ def bench_paged_kernel():
 
     t_paged = timed(paged, kp0, vp0, lens0)
     t_dense = timed(dense, kd0, vp0.reshape(B, CTX, KVH, D), lens0)
+
+    # ragged-vs-split dispatch row (ISSUE 16): the SAME mixed batch —
+    # 6 decode rows + 2 prefill chunks of 64 — as ONE ragged dispatch
+    # vs the split path it replaced (decode kernel + one dispatch per
+    # chunk).  Wall-clock per round on purpose: the delta IS the
+    # tunnel dispatch overhead the ragged program amortizes away.
+    from paddle_tpu.ops.pallas.paged_attention import (
+        ragged_paged_append_attend)
+    CH, S = 64, 8
+    T = 6 + 2 * CH
+    qr = jnp.asarray(rng.standard_normal((T, H, D)), jnp.bfloat16)
+    knr = jnp.asarray(rng.standard_normal((T, KVH, D)), jnp.bfloat16)
+    vnr = jnp.asarray(rng.standard_normal((T, KVH, D)), jnp.bfloat16)
+    dec_kv, pre_kv = CTX - N - 1, 512        # 512 % PAGE == 0
+    qs = jnp.asarray(list(range(6)) + [6, 6 + CH], jnp.int32)
+    ql_mix = jnp.asarray([1] * 6 + [CH, CH], jnp.int32)
+    kv_mix = jnp.asarray([dec_kv] * 6 + [pre_kv, pre_kv], jnp.int32)
+    ql_chunk = [jnp.asarray([0] * 6 + ([CH, 0] if s == 0 else [0, CH]),
+                            jnp.int32) for s in range(2)]
+    qd, knd, vnd = qr[:6], knr[:6], vnr[:6]
+    lens6 = jnp.full((6,), dec_kv, jnp.int32)
+
+    def ragged_round(kp, vp):
+        _, kp, vp = ragged_paged_append_attend(
+            qr, kp, vp, knr, vnr, qs, ql_mix, kv_mix, table)
+        return kp, vp
+
+    def split_round(kp, vp):
+        _, kp, vp = paged_decode_append_attend(
+            qd, kp, vp, knd, vnd, table[:6], lens6)
+        for ql in ql_chunk:                  # one dispatch per chunk
+            _, kp, vp = ragged_paged_append_attend(
+                qr, kp, vp, knr, vnr, qs, ql, kv_mix, table)
+        return kp, vp
+
+    def timed_round(fn, rounds=32):
+        kp, vp = kp0 + 0, vp0 + 0            # donation consumes pools
+        kp, vp = fn(kp, vp)                  # compile + warm
+        jax.block_until_ready((kp, vp))
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                kp, vp = fn(kp, vp)
+            jax.block_until_ready((kp, vp))
+            best = min(best, time.perf_counter() - t0)
+        return best / rounds * 1e6
+
+    t_ragged = timed_round(ragged_round)
+    t_split = timed_round(split_round)
     return {"metric": "paged_decode_kernel_us_per_step",
             "unit": "us", "value": round(t_paged, 1),
             "extra": {"device_kind": kind, "batch": B, "context": CTX,
                       "page_size": PAGE,
                       "dense_us_per_step": round(t_dense, 1),
                       "paged_over_dense": round(t_paged / t_dense, 2),
+                      "ragged_mixed_us_per_round": round(t_ragged, 1),
+                      "split_mixed_us_per_round": round(t_split, 1),
+                      "ragged_over_split": round(t_ragged / t_split, 2),
+                      "ragged_note": "6 decode rows + 2x64-token "
+                                     "prefill chunks: ONE ragged "
+                                     "dispatch vs decode kernel + "
+                                     "per-chunk dispatches (wall-"
+                                     "clock: the delta is tunnel "
+                                     "dispatch overhead)",
                       "note": "fused append+attend kernel, in-graph "
                               "scan x256; r3 path was ~18x dense; the "
                               "dense comparator sped up ~25% when sdpa "
@@ -646,13 +705,15 @@ def bench_engine_window():
     key = jax.random.PRNGKey(0)
 
     def run(n_steps):
-        toks, kp, vp = _paged_decode_step(
+        toks, kp, vp, ks, vs = _paged_decode_step(
             eng._stack, eng._norm_w, eng._head_w, eng._embed_w,
-            eng._rope, eng.cache.k_pages, eng.cache.v_pages, tokens,
+            eng._rope, eng.cache.k_pages, eng.cache.v_pages,
+            eng.cache.k_scales, eng.cache.v_scales, tokens,
             lens, tables, lens, key, eps=eng.eps, kvh=eng.kvh,
             head_dim=eng.head_dim, transpose_head=eng._tied,
             strategy="greedy_search", n_steps=n_steps)
         eng.cache.k_pages, eng.cache.v_pages = kp, vp
+        eng.cache.k_scales, eng.cache.v_scales = ks, vs
         return float(np.asarray(jax.device_get(toks))[0, 0])
 
     for n in (16, 64):                        # compile + warm both
@@ -673,6 +734,80 @@ def bench_engine_window():
                       "note": "marginal (64-16)-step windows; full "
                               "engine path in-graph (sampling + page "
                               "bookkeeping + fused append+attend)"}}
+
+
+def bench_decode_window():
+    """Scanned decode-window row (ISSUE 16): decode tokens/sec through
+    the engine with the ``steps_per_sync`` window host-chained
+    (``scan_decode=False``: nsteps dispatches per window) vs ON-DEVICE
+    (one compiled while_loop program per window), at steps_per_sync
+    1/4/16 on a decode-heavy small batch — the regime where
+    per-dispatch overhead dominates.  CPU-runnable on the tiny config;
+    rounds are INTERLEAVED best-of-3 so load drift cannot favor either
+    path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny_config)
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        plens, new, page, mlen = [96, 57, 128, 101], 256, 128, 2048
+        dtype = jnp_bf16()
+    else:
+        cfg = llama_tiny_config()
+        plens, new, page, mlen = [8, 5], 33, 8, 64
+        dtype = np.float32
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, n).tolist()
+               for n in plens]
+
+    def run(sps, scan):
+        eng = LLMEngine(model, max_seqs=len(prompts), max_len=mlen,
+                        page_size=page, dtype=dtype,
+                        steps_per_sync=sps, scan_decode=scan)
+        for i, p in enumerate(prompts):
+            eng.add_request(f"w{i}", p, max_new_tokens=new)
+        eng.step()                           # prefill outside the clock
+        base = sum(len(r.out) for r in eng.requests.values())
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.out) for r in eng.requests.values()) - base
+        return toks / dt
+
+    cfgs = [(1, False), (4, False), (4, True), (16, False), (16, True)]
+    for sps, scan in cfgs:                   # compile warm-up passes
+        run(sps, scan)
+    best = {c: 0.0 for c in cfgs}
+    for _ in range(3):                       # interleaved best-of
+        for c in cfgs:
+            best[c] = max(best[c], run(*c))
+    rows = {f"sps{sps}_{'scan' if sc else 'host'}_tokens_per_sec":
+            round(v, 1) for (sps, sc), v in best.items()}
+    return {"metric": "engine_decode_window_tokens_per_sec",
+            "unit": "tokens/sec", "value": round(best[(16, True)], 1),
+            "extra": {"device_kind": kind, "batch": len(prompts),
+                      "new_tokens": new, **rows,
+                      "scan_over_host_sps4":
+                          round(best[(4, True)] / best[(4, False)], 2),
+                      "scan_over_host_sps16":
+                          round(best[(16, True)] / best[(16, False)],
+                                2),
+                      "window_compiles": LLMEngine.window_compiles(),
+                      "note": "decode-heavy small batch; scanned "
+                              "window = ONE while_loop program per "
+                              "steps_per_sync window (early-exit on "
+                              "all-rows-done) vs host-chained "
+                              "per-token dispatch"}}
 
 
 def bench_engine():
@@ -2036,6 +2171,7 @@ def main():
                ("bench_ckpt", bench_ckpt),
                ("bench_train_fused", bench_train_fused),
                ("bench_engine_window", bench_engine_window),
+               ("bench_decode_window", bench_decode_window),
                ("bench_longseq", bench_longseq)]
         failed = 0
         for fname, fn in fns:

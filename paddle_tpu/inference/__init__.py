@@ -1,6 +1,8 @@
 from .predictor import Config, PredictorTensor, Predictor, create_predictor
 from .paged_cache import PagedKVCache
 from .engine import GenRequest, LLMEngine
+from .sampling import sample_logits, split_step, window_keys
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
-           "PagedKVCache", "LLMEngine", "GenRequest"]
+           "PagedKVCache", "LLMEngine", "GenRequest",
+           "sample_logits", "split_step", "window_keys"]

@@ -57,6 +57,20 @@ item 2).  ``add_request`` remains the synchronous admission path;
 tokens are bit-identical between the unified and split programs
 (greedy decoding).
 
+On-device decode windows (``scan_decode=True``, default): a
+``steps_per_sync > 1`` pure-decode window runs as ONE compiled
+``lax.while_loop`` program — attend (ragged Pallas kernel, pools
+aliased in place), sample, KV-append, token feed-back chained
+in-graph — syncing the host only at the window boundary, with early
+exit once every row has hit EOS or its budget (per-row emitted counts
+come back so the host merge stays exact).  Window lengths bucket to
+powers of two (one compile per bucket, declared to the CompileWatch
+at construction); the per-step body IS the single-step program's
+body and the key sequence is the same ``inference.sampling``
+``split_step`` chain, so tokens are bit-identical to host-chained
+dispatch on every path — plain, int8 KV, prefix hits,
+preempt→resume, migration.
+
 Automatic prefix caching (``enable_prefix_caching=``, default on):
 admission looks up the longest cached page-aligned prefix of the
 prompt in the paged cache's chain-hash index, maps those pages into
@@ -281,57 +295,44 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
     return logits, k_pages, v_pages, k_scales, v_scales
 
 
-@functools.partial(
-    __import__("jax").jit,
-    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
-                     "strategy", "top_k", "top_p", "temperature",
-                     "n_steps"),
-    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
-def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
-                       k_pages, v_pages, k_scales, v_scales,
-                       tokens, positions, tables, lens,
-                       key, *, eps: float, kvh: int, head_dim: int,
-                       transpose_head: bool = False,
-                       strategy: str = "greedy_search", top_k: int = 0,
-                       top_p: float = 1.0, temperature: float = 1.0,
-                       n_steps: int = 1):
-    """``n_steps`` decode tokens for every active sequence as ONE XLA
-    program (multi-step scheduling: the host syncs — EOS checks,
-    admission — every n_steps tokens, so dispatch latency amortizes
-    over n_steps; page capacity for all n_steps is pre-allocated by the
-    caller).
+def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
+                         *, eps, kvh, head_dim, transpose_head,
+                         strategy, top_k, top_p, temperature):
+    """Build the one-token decode body shared by ``_paged_decode_step``
+    (fixed-length window) and ``_paged_decode_window`` (the early-exit
+    scanned window).  ONE definition of the per-step math — embed,
+    rope, fused append+attend, sample, ``split_step`` key chain — is
+    what makes the two programs bit-identical step for step.
 
-    stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
-    order; weight-only-int8 entries are (values, scale) pairs);
-    k/v_pages [L, KVH, n_pages, P, D]; k/v_scales [L, KVH, n_pages, P]
-    f32 per-token dequant scales for int8 pools (None for fp); tokens
-    [B] int32; positions [B] (= current lengths); tables [B, maxp];
-    lens [B].  Returns (tokens [n_steps, B], k_pages', v_pages',
-    k_scales', v_scales').
+    carry: (tokens [B], positions [B], lens [B], k_pages, v_pages,
+    k_scales, v_scales, key) → the same tuple one step later, with the
+    sampled token in slot 0.
     """
     import jax
     import jax.numpy as jnp
 
     from ..ops import _nn
     from ..ops.pallas.paged_attention import (
-        paged_decode_append_attend, paged_decode_append_attend_reference)
+        paged_decode_append_attend_raw,
+        paged_decode_append_attend_reference)
     from ..runtime.device import is_compiled_with_tpu
+    from ..models.llama import _rotate_half as rotate_half
+    from .sampling import sample_logits, split_step
 
     cos_t, sin_t = rope                       # [maxpos, D]
-    b = tokens.shape[0]
-
-    from ..models.llama import _rotate_half as rotate_half
-    from ..nn.generation import sample_logits
 
     # ONE fused kernel appends this step's K/V and attends over them —
     # the separate XLA paged_write rewrote the whole pool per step on
-    # TPU (round-3 serving bottleneck; see paged_attention.py)
-    append_attend = paged_decode_append_attend if is_compiled_with_tpu() \
-        else paged_decode_append_attend_reference
+    # TPU (round-3 serving bottleneck; see paged_attention.py).  The
+    # _raw form: this body is traced INSIDE an already-jitted program,
+    # often inside its scan/while loop.
+    append_attend = paged_decode_append_attend_raw \
+        if is_compiled_with_tpu() else paged_decode_append_attend_reference
 
     def one_token(carry):
         (tokens, positions, lens, k_pages, v_pages, k_scales, v_scales,
          key) = carry
+        b = tokens.shape[0]
         x = jnp.take(embed_w, tokens, axis=0)  # [B, H]
         cos = jnp.take(cos_t, positions, axis=0)[:, None, :]  # [B,1,D]
         sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
@@ -371,12 +372,51 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
         x = _nn.rms_norm(x, norm_w, epsilon=eps)
         logits = jnp.matmul(x, head_w.T) if transpose_head \
             else _mm(x, head_w)
-        key, sub = jax.random.split(key)
+        key, sub = split_step(key)
         nxt, _ = sample_logits(logits, sub, strategy=strategy,
                                top_k=top_k, top_p=top_p,
                                temperature=temperature)
         return (nxt, positions + 1, lens + 1, k_pages, v_pages,
                 k_scales, v_scales, key)
+
+    return one_token
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature",
+                     "n_steps"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
+def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
+                       k_pages, v_pages, k_scales, v_scales,
+                       tokens, positions, tables, lens,
+                       key, *, eps: float, kvh: int, head_dim: int,
+                       transpose_head: bool = False,
+                       strategy: str = "greedy_search", top_k: int = 0,
+                       top_p: float = 1.0, temperature: float = 1.0,
+                       n_steps: int = 1):
+    """``n_steps`` decode tokens for every active sequence as ONE XLA
+    program (multi-step scheduling: the host syncs — EOS checks,
+    admission — every n_steps tokens, so dispatch latency amortizes
+    over n_steps; page capacity for all n_steps is pre-allocated by the
+    caller).
+
+    stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
+    order; weight-only-int8 entries are (values, scale) pairs);
+    k/v_pages [L, KVH, n_pages, P, D]; k/v_scales [L, KVH, n_pages, P]
+    f32 per-token dequant scales for int8 pools (None for fp); tokens
+    [B] int32; positions [B] (= current lengths); tables [B, maxp];
+    lens [B].  Returns (tokens [n_steps, B], k_pages', v_pages',
+    k_scales', v_scales').
+    """
+    import jax
+
+    one_token = _decode_one_token_fn(
+        stack, norm_w, head_w, embed_w, rope, tables,
+        eps=eps, kvh=kvh, head_dim=head_dim,
+        transpose_head=transpose_head, strategy=strategy, top_k=top_k,
+        top_p=top_p, temperature=temperature)
 
     if n_steps == 1:
         (nxt, _, _, k_pages, v_pages, k_scales, v_scales, _) = one_token(
@@ -394,6 +434,177 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                    v_scales, key),
             None, length=n_steps)
     return toks, k_pages, v_pages, k_scales, v_scales
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature",
+                     "n_steps"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
+def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
+                         k_pages, v_pages, k_scales, v_scales,
+                         tokens, positions, tables, lens, key,
+                         eos_ids, budgets, n_live, *,
+                         eps: float, kvh: int, head_dim: int,
+                         transpose_head: bool = False,
+                         strategy: str = "greedy_search", top_k: int = 0,
+                         top_p: float = 1.0, temperature: float = 1.0,
+                         n_steps: int = 2):
+    """The split path's ON-DEVICE decode window with EARLY EXIT: up to
+    ``n_steps`` tokens per dispatch (same per-step body as
+    ``_paged_decode_step`` — ``_decode_one_token_fn`` — so the token
+    stream is bit-identical), but a ``lax.while_loop`` stops as soon as
+    every live row has hit its EOS (``eos_ids``, −1 = none) or emitted
+    its remaining budget (``budgets`` = max_new − len(out) at window
+    start).  The host merge loop discards a finished row's surplus
+    tokens either way, so exiting early changes NOTHING observable —
+    it just stops paying for steps no row needs.  Like the host path,
+    rows keep computing (and appending into soon-released pages) while
+    ANY row still runs: per-row masking would change nothing and cost
+    a select on every tensor.
+
+    eos_ids/budgets [B] int32 (pad rows: −1 / 1); ``n_live`` the count
+    of real rows (traced — the compiled shape stays one per n_steps
+    bucket).  Returns (tokens [n_steps, B] — rows ≥ steps_done are
+    zero-filled, the host must slice with steps_done —, emitted [B]
+    int32 per-row delivered-token counts, steps_done, k_pages',
+    v_pages', k_scales', v_scales').
+    """
+    import jax
+    import jax.numpy as jnp
+
+    one_token = _decode_one_token_fn(
+        stack, norm_w, head_w, embed_w, rope, tables,
+        eps=eps, kvh=kvh, head_dim=head_dim,
+        transpose_head=transpose_head, strategy=strategy, top_k=top_k,
+        top_p=top_p, temperature=temperature)
+
+    b = tokens.shape[0]
+    live = jnp.arange(b) < n_live
+    state0 = (tokens, positions, lens, k_pages, v_pages, k_scales,
+              v_scales, key)
+    toks0 = jnp.zeros((n_steps, b), jnp.int32)
+    carry0 = (jnp.zeros((), jnp.int32), state0, toks0,
+              jnp.logical_not(live), jnp.zeros(b, jnp.int32))
+
+    def cond(carry):
+        si, _, _, done, _ = carry
+        return jnp.logical_and(si < n_steps,
+                               jnp.logical_not(jnp.all(done)))
+
+    def body(carry):
+        si, state, toks, done, emitted = carry
+        state = one_token(state)
+        nxt = state[0].astype(jnp.int32)
+        toks = jax.lax.dynamic_update_slice(toks, nxt[None], (si, 0))
+        # mirror the host merge EXACTLY: a row emits while not done;
+        # it retires on EOS or on filling its budget (the window never
+        # exceeds the smallest budget, so budget exhaustion can only
+        # land on the window's last step — but the same test keeps the
+        # invariant local instead of trusting the caller)
+        fresh = jnp.logical_not(done)
+        emitted = emitted + fresh.astype(jnp.int32)
+        hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+        done = jnp.logical_or(
+            done, jnp.logical_and(fresh, jnp.logical_or(
+                hit_eos, emitted >= budgets)))
+        return (si + 1, state, toks, done, emitted)
+
+    si, state, toks, done, emitted = jax.lax.while_loop(
+        cond, body, carry0)
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, _) = state
+    return (toks, emitted, si, k_pages, v_pages, k_scales, v_scales)
+
+
+def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
+                   k_pages, v_pages, k_scales, v_scales,
+                   ids, positions, row_tables,
+                   q_start, q_len, kv_len, desc_tables,
+                   desc_of_row, off_of_row, key, *,
+                   eps: float, kvh: int, head_dim: int,
+                   transpose_head: bool = False,
+                   strategy: str = "greedy_search", top_k: int = 0,
+                   top_p: float = 1.0, temperature: float = 1.0):
+    """Un-jitted body of ``_paged_mixed_step`` — ALSO the per-step body
+    of ``_paged_mixed_window``'s on-device loop, which is what makes
+    the scanned window bit-identical to host-chained dispatch: the two
+    paths trace the very same ops in the very same order (see
+    ``_paged_mixed_step`` for the argument contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import _nn
+    from ..ops.pallas.paged_attention import (
+        ragged_paged_append_attend_raw,
+        ragged_paged_append_attend_reference)
+    from ..runtime.device import is_compiled_with_tpu
+
+    cos_t, sin_t = rope
+    t = ids.shape[0]
+
+    from ..models.llama import _rotate_half as rotate_half
+    from .sampling import sample_logits, split_step
+
+    x = jnp.take(embed_w, ids, axis=0)             # [T, H]
+    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [T, 1, D]
+    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
+    on_tpu = is_compiled_with_tpu()
+
+    def layer(carry, xs):
+        hcur = carry
+        lp, kp, vp, ksp, vsp = xs              # per-layer params + pools
+        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
+        nh = _wout(qw) // head_dim
+        q = _mm(hn, qw).reshape(t, nh, head_dim)
+        k = _mm(hn, kw).reshape(t, kvh, head_dim)
+        v = _mm(hn, vw).reshape(t, kvh, head_dim)
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
+        k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
+        if on_tpu:
+            # ragged kernel: per-descriptor [P, H, D] output blocks,
+            # gathered back to the flat row order
+            if ksp is None:
+                blocks, kp, vp = ragged_paged_append_attend_raw(
+                    q, kp, vp, k, v, q_start, q_len, kv_len,
+                    desc_tables)
+            else:
+                blocks, kp, vp, ks4, vs4 = \
+                    ragged_paged_append_attend_raw(
+                        q, kp, vp, k, v, q_start, q_len, kv_len,
+                        desc_tables, ksp[:, :, None, :],
+                        vsp[:, :, None, :])
+                ksp = ks4.reshape(ksp.shape)
+                vsp = vs4.reshape(vsp.shape)
+            attn = blocks[desc_of_row, off_of_row]          # [T, NH, D]
+        elif ksp is None:
+            attn, kp, vp = ragged_paged_append_attend_reference(
+                q, kp, vp, k, v, positions, row_tables)
+        else:
+            attn, kp, vp, ks4, vs4 = \
+                ragged_paged_append_attend_reference(
+                    q, kp, vp, k, v, positions, row_tables,
+                    ksp[:, :, None, :], vsp[:, :, None, :])
+            ksp = ks4.reshape(ksp.shape)
+            vsp = vs4.reshape(vsp.shape)
+        hcur = hcur + _mm(attn.reshape(t, nh * head_dim), ow)
+        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
+        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
+        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
+
+    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    x = _nn.rms_norm(x, norm_w, epsilon=eps)
+    logits = jnp.matmul(x, head_w.T) if transpose_head \
+        else _mm(x, head_w)
+    key, sub = split_step(key)
+    nxt, _ = sample_logits(logits, sub, strategy=strategy,
+                           top_k=top_k, top_p=top_p,
+                           temperature=temperature)
+    return nxt, k_pages, v_pages, k_scales, v_scales, key
 
 
 @functools.partial(
@@ -433,78 +644,105 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
     writes land in the reserved pad page.  Returns (next_token [T],
     k_pages', v_pages', k_scales', v_scales', key') — the key chains
     across host-driven multi-token windows."""
+    return _mixed_forward(
+        stack, norm_w, head_w, embed_w, rope,
+        k_pages, v_pages, k_scales, v_scales,
+        ids, positions, row_tables, q_start, q_len, kv_len,
+        desc_tables, desc_of_row, off_of_row, key,
+        eps=eps, kvh=kvh, head_dim=head_dim,
+        transpose_head=transpose_head, strategy=strategy,
+        top_k=top_k, top_p=top_p, temperature=temperature)
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature",
+                     "n_steps"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
+def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
+                        k_pages, v_pages, k_scales, v_scales,
+                        ids, positions, row_tables,
+                        q_start, q_len, kv_len, desc_tables,
+                        desc_of_row, off_of_row, key,
+                        eos_ids, budgets, n_rows, *,
+                        eps: float, kvh: int, head_dim: int,
+                        transpose_head: bool = False,
+                        strategy: str = "greedy_search", top_k: int = 0,
+                        top_p: float = 1.0, temperature: float = 1.0,
+                        n_steps: int = 2):
+    """The unified path's ON-DEVICE decode window: up to ``n_steps``
+    pure-decode steps of ``_mixed_forward`` — attend+append (the
+    ragged kernel, aliases intact), sample, feed-back — chained in a
+    ``lax.while_loop`` so the whole window is ONE dispatch, with EARLY
+    EXIT once every live row has retired (its EOS ``eos_ids[i]``, −1
+    for none, or its remaining budget ``budgets[i]``).  The in-graph
+    feedback is exactly the host chain: row < n_rows gets its sampled
+    token as the next input with position/kv_len bumped — including
+    already-retired rows, whose surplus tokens the host merge discards
+    just as it does on the host-chained path (computing them keeps the
+    two paths op-identical; their appends land in pages that release
+    at retirement).  The key chains through ``split_step`` inside the
+    graph — the same sequence the host-chained window derives.
+
+    Only pure-decode windows dispatch here (the caller forces
+    ``nsteps == 1`` whenever prefill chunks are packed), so q_len is
+    constant 1 for live rows across the loop.  Returns
+    (tokens [n_steps, T] — step rows ≥ steps_done zero-filled —,
+    emitted [T] per-row delivered counts, steps_done, k_pages',
+    v_pages', k_scales', v_scales', key')."""
     import jax
     import jax.numpy as jnp
 
-    from ..ops import _nn
-    from ..ops.pallas.paged_attention import (
-        ragged_paged_append_attend, ragged_paged_append_attend_reference)
-    from ..runtime.device import is_compiled_with_tpu
-
-    cos_t, sin_t = rope
     t = ids.shape[0]
+    live = jnp.arange(t) < n_rows
+    toks0 = jnp.zeros((n_steps, t), jnp.int32)
+    carry0 = (jnp.zeros((), jnp.int32),
+              (ids, positions, kv_len, k_pages, v_pages, k_scales,
+               v_scales, key),
+              toks0, jnp.logical_not(live), jnp.zeros(t, jnp.int32))
 
-    from ..models.llama import _rotate_half as rotate_half
-    from ..nn.generation import sample_logits
+    def cond(carry):
+        si, _, _, done, _ = carry
+        return jnp.logical_and(si < n_steps,
+                               jnp.logical_not(jnp.all(done)))
 
-    x = jnp.take(embed_w, ids, axis=0)             # [T, H]
-    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [T, 1, D]
-    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
-    on_tpu = is_compiled_with_tpu()
+    def body(carry):
+        si, state, toks, done, emitted = carry
+        (ids, positions, kv_len, k_pages, v_pages, k_scales, v_scales,
+         key) = state
+        (nxt, k_pages, v_pages, k_scales, v_scales, key) = \
+            _mixed_forward(
+                stack, norm_w, head_w, embed_w, rope,
+                k_pages, v_pages, k_scales, v_scales,
+                ids, positions, row_tables, q_start, q_len, kv_len,
+                desc_tables, desc_of_row, off_of_row, key,
+                eps=eps, kvh=kvh, head_dim=head_dim,
+                transpose_head=transpose_head, strategy=strategy,
+                top_k=top_k, top_p=top_p, temperature=temperature)
+        nxt = nxt.astype(jnp.int32)
+        toks = jax.lax.dynamic_update_slice(toks, nxt[None], (si, 0))
+        fresh = jnp.logical_not(done)
+        emitted = emitted + fresh.astype(jnp.int32)
+        hit_eos = jnp.logical_and(eos_ids >= 0, nxt == eos_ids)
+        done = jnp.logical_or(
+            done, jnp.logical_and(fresh, jnp.logical_or(
+                hit_eos, emitted >= budgets)))
+        # the host-chained feedback, in-graph: live rows advance, pad
+        # rows keep position 0 / the pad table
+        ids = jnp.where(live, nxt, ids)
+        positions = jnp.where(live, positions + 1, positions)
+        kv_len = jnp.where(live, kv_len + 1, kv_len)
+        return (si + 1,
+                (ids, positions, kv_len, k_pages, v_pages, k_scales,
+                 v_scales, key),
+                toks, done, emitted)
 
-    def layer(carry, xs):
-        hcur = carry
-        lp, kp, vp, ksp, vsp = xs              # per-layer params + pools
-        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
-        hn = _nn.rms_norm(hcur, iln, epsilon=eps)
-        nh = _wout(qw) // head_dim
-        q = _mm(hn, qw).reshape(t, nh, head_dim)
-        k = _mm(hn, kw).reshape(t, kvh, head_dim)
-        v = _mm(hn, vw).reshape(t, kvh, head_dim)
-        qf = q.astype(jnp.float32)
-        kf = k.astype(jnp.float32)
-        q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
-        k = (kf * cos + rotate_half(kf) * sin).astype(k.dtype)
-        if on_tpu:
-            # ragged kernel: per-descriptor [P, H, D] output blocks,
-            # gathered back to the flat row order
-            if ksp is None:
-                blocks, kp, vp = ragged_paged_append_attend(
-                    q, kp, vp, k, v, q_start, q_len, kv_len,
-                    desc_tables)
-            else:
-                blocks, kp, vp, ks4, vs4 = ragged_paged_append_attend(
-                    q, kp, vp, k, v, q_start, q_len, kv_len,
-                    desc_tables, ksp[:, :, None, :],
-                    vsp[:, :, None, :])
-                ksp = ks4.reshape(ksp.shape)
-                vsp = vs4.reshape(vsp.shape)
-            attn = blocks[desc_of_row, off_of_row]          # [T, NH, D]
-        elif ksp is None:
-            attn, kp, vp = ragged_paged_append_attend_reference(
-                q, kp, vp, k, v, positions, row_tables)
-        else:
-            attn, kp, vp, ks4, vs4 = \
-                ragged_paged_append_attend_reference(
-                    q, kp, vp, k, v, positions, row_tables,
-                    ksp[:, :, None, :], vsp[:, :, None, :])
-            ksp = ks4.reshape(ksp.shape)
-            vsp = vs4.reshape(vsp.shape)
-        hcur = hcur + _mm(attn.reshape(t, nh * head_dim), ow)
-        hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
-        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
-
-    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
-        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
-    x = _nn.rms_norm(x, norm_w, epsilon=eps)
-    logits = jnp.matmul(x, head_w.T) if transpose_head \
-        else _mm(x, head_w)
-    key, sub = jax.random.split(key)
-    nxt, _ = sample_logits(logits, sub, strategy=strategy,
-                           top_k=top_k, top_p=top_p,
-                           temperature=temperature)
-    return nxt, k_pages, v_pages, k_scales, v_scales, key
+    si, state, toks, done, emitted = jax.lax.while_loop(
+        cond, body, carry0)
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, key) = state
+    return (toks, emitted, si, k_pages, v_pages, k_scales, v_scales,
+            key)
 
 
 class LLMEngine:
@@ -522,7 +760,8 @@ class LLMEngine:
                  enable_prefix_caching: bool = True,
                  swap_pool_pages: Optional[int] = None,
                  unified_step: bool = True,
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 scan_decode: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -538,6 +777,15 @@ class LLMEngine:
         enforce(weight_dtype in (None, "int8"),
                 f"unsupported weight_dtype {weight_dtype!r}")
         self.steps_per_sync = steps_per_sync
+        # on-device decode windows: steps_per_sync > 1 windows run as
+        # ONE compiled while_loop program (attend → sample → KV-append
+        # chained in-graph, early exit when every row retires) instead
+        # of host-chained single-token dispatches.  Bit-identical by
+        # construction — the window program's step body IS the
+        # single-step program's body.  False restores host chaining
+        # (debugging / A-B benches).
+        self.scan_decode = bool(scan_decode)
+        self.last_window_steps = 0
         self.decode_strategy = decode_strategy
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -669,6 +917,17 @@ class LLMEngine:
         cw.register_program("engine.decode_step",
                             expected=int(steps_per_sync).bit_length())
         cw.register_program("engine.mixed_step")
+        # scanned windows: one program per power-of-two window bucket
+        # {2, 4, ..., 2^floor(log2(steps_per_sync))} — the n_steps==1
+        # window degenerates to the plain step program above, so the
+        # bucket count is bit_length − 1 and ``mixed_compiles()`` stays
+        # bounded by DECLARED allowances (a recompile past them is an
+        # anomaly the watch flags)
+        wb = max(int(steps_per_sync).bit_length() - 1, 0)
+        if self.scan_decode and wb:
+            cw.register_program(
+                "engine.mixed_window" if self.unified_step
+                else "engine.decode_window", expected=wb)
         # the paged KV pool (device pages + host swap) as a first-class
         # /memz row; weakly held so a released engine frees its pages
         _insp.register_memory_consumer(
@@ -779,13 +1038,20 @@ class LLMEngine:
         self._metrics["mixed_compiles"] = reg.gauge(
             "llm_engine_mixed_compiles",
             "Distinct compiled unified mixed-step programs "
-            "(expected: 1 per engine geometry).")
+            "(expected: 1 per engine geometry, plus one scanned "
+            "mixed-window program per power-of-two window bucket).")
+        self._metrics["window_compiles"] = reg.gauge(
+            "llm_engine_window_compiles",
+            "Distinct compiled on-device decode-window programs "
+            "(expected: at most log2(steps_per_sync) power-of-two "
+            "buckets; 0 with scan_decode off).")
 
     def _record_compiles(self):
         m = self._metrics
         m["prefill_compiles"].set(self.prefill_compiles())
         m["decode_compiles"].set(self.decode_compiles())
         m["mixed_compiles"].set(self.mixed_compiles())
+        m["window_compiles"].set(self.window_compiles())
 
     # -- prefill / replay internals --------------------------------------------
     def _prefill_seq(self, slot, seq, start_chunk: int):
@@ -1070,7 +1336,10 @@ class LLMEngine:
         syncs (EOS checks, admission window) once per call, so over a
         high-latency dispatch path (remote PJRT) throughput scales with
         steps_per_sync; the window never exceeds any request's
-        remaining token budget, so page capacity is exact."""
+        remaining token budget, so page capacity is exact.  With
+        ``scan_decode`` (default) multi-step windows run the early-exit
+        ``_paged_decode_window`` while_loop program; otherwise the
+        fixed-length ``_paged_decode_step`` scan."""
         import jax
         import jax.numpy as jnp
 
@@ -1105,24 +1374,62 @@ class LLMEngine:
         self._key, sub = jax.random.split(self._key)
         t_win = time.perf_counter()
         with RecordEvent("llm_engine.decode"):
-            (toks, self.cache.k_pages, self.cache.v_pages,
-             self.cache.k_scales, self.cache.v_scales) = \
-                _insp.watched_call(
-                    "engine.decode_step", _paged_decode_step,
-                    self._stack, self._norm_w, self._head_w,
-                    self._embed_w, self._rope, self.cache.k_pages,
-                    self.cache.v_pages, self.cache.k_scales,
-                    self.cache.v_scales, jnp.asarray(tokens),
-                    jnp.asarray(lens, np.int32), jnp.asarray(tables),
-                    jnp.asarray(lens, np.int32), sub,
-                    eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
-                    transpose_head=self._tied,
-                    strategy=self.decode_strategy,
-                    top_k=self.top_k, top_p=self.top_p,
-                    temperature=self.temperature, n_steps=nsteps)
-            self.cache.advance(slots, nsteps)
-            toks = np.asarray(jax.device_get(toks))[:, :n]  # [nsteps, n]
+            if self.scan_decode and nsteps > 1:
+                # on-device window: one while_loop program runs the
+                # whole window, exiting early once every row retired
+                # (EOS/budget tracked in-graph — same predicate as the
+                # merge loop below)
+                eos_ids = np.full(self.max_seqs, -1, np.int32)
+                budgets = np.ones(self.max_seqs, np.int32)
+                for i, r in enumerate(batch):
+                    if r.eos is not None:
+                        eos_ids[i] = r.eos
+                    budgets[i] = r.max_new - len(r.out)
+                (toks, _, steps_d, self.cache.k_pages,
+                 self.cache.v_pages, self.cache.k_scales,
+                 self.cache.v_scales) = \
+                    _insp.watched_call(
+                        "engine.decode_window", _paged_decode_window,
+                        self._stack, self._norm_w, self._head_w,
+                        self._embed_w, self._rope, self.cache.k_pages,
+                        self.cache.v_pages, self.cache.k_scales,
+                        self.cache.v_scales, jnp.asarray(tokens),
+                        jnp.asarray(lens, np.int32),
+                        jnp.asarray(tables),
+                        jnp.asarray(lens, np.int32), sub,
+                        jnp.asarray(eos_ids), jnp.asarray(budgets),
+                        jnp.int32(n),
+                        eps=self.eps, kvh=self.kvh,
+                        head_dim=self.head_dim,
+                        transpose_head=self._tied,
+                        strategy=self.decode_strategy,
+                        top_k=self.top_k, top_p=self.top_p,
+                        temperature=self.temperature, n_steps=nsteps)
+                steps_done = int(jax.device_get(steps_d))
+            else:
+                (toks, self.cache.k_pages, self.cache.v_pages,
+                 self.cache.k_scales, self.cache.v_scales) = \
+                    _insp.watched_call(
+                        "engine.decode_step", _paged_decode_step,
+                        self._stack, self._norm_w, self._head_w,
+                        self._embed_w, self._rope, self.cache.k_pages,
+                        self.cache.v_pages, self.cache.k_scales,
+                        self.cache.v_scales, jnp.asarray(tokens),
+                        jnp.asarray(lens, np.int32),
+                        jnp.asarray(tables),
+                        jnp.asarray(lens, np.int32), sub,
+                        eps=self.eps, kvh=self.kvh,
+                        head_dim=self.head_dim,
+                        transpose_head=self._tied,
+                        strategy=self.decode_strategy,
+                        top_k=self.top_k, top_p=self.top_p,
+                        temperature=self.temperature, n_steps=nsteps)
+                steps_done = nsteps
+            self.cache.advance(slots, steps_done)
+            # [steps_done, n]
+            toks = np.asarray(jax.device_get(toks))[:steps_done, :n]
         dt_win = time.perf_counter() - t_win
+        self.last_window_steps = steps_done
 
         # contract (ADVICE r3): with steps_per_sync > 1 a window emits
         # up to nsteps tokens per request — return the LIST of new
@@ -1130,7 +1437,7 @@ class LLMEngine:
         out = {}
         for i, req in enumerate(batch):
             new_toks = []
-            for j in range(nsteps):
+            for j in range(steps_done):
                 if req.done:
                     break
                 tok = int(toks[j, i])
@@ -1143,13 +1450,22 @@ class LLMEngine:
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
-        _health.get_health().observe_tpot(dt_win / nsteps, n=nsteps)
+        # TPOT counts only tokens actually DELIVERED to a stream: a
+        # request that retired mid-window stops contributing positions
+        # (the fixed window-boundary over-count), and the window's
+        # per-token wall time is wall / steps actually run
+        delivered = max((len(v) for v in out.values()), default=0)
+        if delivered:
+            _health.get_health().observe_tpot(dt_win / steps_done,
+                                              n=delivered)
         if self._metrics is not None:
             m = self._metrics
             # ONE weighted observe per window: value is the wall time a
             # stream waits per token, count advances by the window's
-            # token positions — O(1) recording however long the window
-            m["tpot"].observe(dt_win / nsteps, n=nsteps)
+            # DELIVERED token positions — O(1) recording however long
+            # the window
+            if delivered:
+                m["tpot"].observe(dt_win / steps_done, n=delivered)
             m["generated_tokens"].inc(
                 sum(len(v) for v in out.values()))
             m["queue_depth"].set(len(self._active))
@@ -1164,9 +1480,12 @@ class LLMEngine:
         prefill chunks packed FIFO up to the runtime
         ``prefill_token_budget`` (chunks never cross page boundaries,
         so one request may contribute several descriptors).  When no
-        prefill is pending, the ``steps_per_sync`` window runs as
-        host-chained single-token dispatches of the SAME program —
-        never a second compiled shape."""
+        prefill is pending, the ``steps_per_sync`` window dispatches
+        ONCE as the on-device ``_paged_mixed_window`` program
+        (scan_decode, power-of-two buckets, early exit) or — with
+        ``scan_decode=False`` — as host-chained single-token
+        dispatches of the mixed program; both orders are bit-identical
+        by construction."""
         import jax
         import jax.numpy as jnp
 
@@ -1253,6 +1572,7 @@ class LLMEngine:
         self._key, sub = jax.random.split(self._key)
         key = sub
         toks_all = []
+        steps_done = nsteps
         t_win = time.perf_counter()
         span = _tracing.span("engine.mixed_step")
         span.set_attr("decode_slots", n)
@@ -1260,11 +1580,23 @@ class LLMEngine:
         span.set_attr("nsteps", nsteps)
         try:
             with RecordEvent("llm_engine.decode"):
-                for si in range(nsteps):
-                    (nxt, self.cache.k_pages, self.cache.v_pages,
-                     self.cache.k_scales, self.cache.v_scales, key) = \
+                if self.scan_decode and nsteps > 1:
+                    # ON-DEVICE window (pure decode by construction —
+                    # prefill plans force nsteps == 1): the whole
+                    # attend → sample → append chain runs as one
+                    # while_loop program that exits as soon as every
+                    # row has retired, syncing the host once
+                    eos_ids = np.full(t_cap, -1, np.int32)
+                    budgets = np.ones(t_cap, np.int32)
+                    for i, r in enumerate(batch):
+                        if r.eos is not None:
+                            eos_ids[i] = r.eos
+                        budgets[i] = r.max_new - len(r.out)
+                    (toks_d, _, steps_d, self.cache.k_pages,
+                     self.cache.v_pages, self.cache.k_scales,
+                     self.cache.v_scales, key) = \
                         _insp.watched_call(
-                            "engine.mixed_step", _paged_mixed_step,
+                            "engine.mixed_window", _paged_mixed_window,
                             self._stack, self._norm_w, self._head_w,
                             self._embed_w, self._rope,
                             self.cache.k_pages, self.cache.v_pages,
@@ -1276,30 +1608,69 @@ class LLMEngine:
                             jnp.asarray(desc_tables),
                             jnp.asarray(desc_of_row),
                             jnp.asarray(off_of_row), key,
+                            jnp.asarray(eos_ids),
+                            jnp.asarray(budgets), jnp.int32(n),
                             eps=self.eps, kvh=self.kvh,
                             head_dim=self.head_dim,
                             transpose_head=self._tied,
                             strategy=self.decode_strategy,
                             top_k=self.top_k, top_p=self.top_p,
-                            temperature=self.temperature)
-                    nxt = np.asarray(jax.device_get(nxt))
-                    toks_all.append(nxt)
+                            temperature=self.temperature,
+                            n_steps=nsteps)
+                    steps_done = int(jax.device_get(steps_d))
+                    toks_np = np.asarray(jax.device_get(toks_d))
+                    toks_all = [toks_np[j] for j in range(steps_done)]
                     if n:
-                        self.cache.advance(slots, 1)
-                    if si + 1 < nsteps:
-                        # host-chained window (pure decode): feed each
-                        # slot's sampled token back as the next input
-                        ids[:n] = nxt[:n]
-                        positions[:n] += 1
-                        kv_len[:n] += 1
+                        self.cache.advance(slots, steps_done)
+                else:
+                    for si in range(nsteps):
+                        (nxt, self.cache.k_pages, self.cache.v_pages,
+                         self.cache.k_scales, self.cache.v_scales,
+                         key) = \
+                            _insp.watched_call(
+                                "engine.mixed_step", _paged_mixed_step,
+                                self._stack, self._norm_w,
+                                self._head_w, self._embed_w,
+                                self._rope,
+                                self.cache.k_pages, self.cache.v_pages,
+                                self.cache.k_scales,
+                                self.cache.v_scales,
+                                jnp.asarray(ids),
+                                jnp.asarray(positions),
+                                jnp.asarray(row_tables),
+                                jnp.asarray(q_start),
+                                jnp.asarray(q_len),
+                                jnp.asarray(kv_len),
+                                jnp.asarray(desc_tables),
+                                jnp.asarray(desc_of_row),
+                                jnp.asarray(off_of_row), key,
+                                eps=self.eps, kvh=self.kvh,
+                                head_dim=self.head_dim,
+                                transpose_head=self._tied,
+                                strategy=self.decode_strategy,
+                                top_k=self.top_k, top_p=self.top_p,
+                                temperature=self.temperature)
+                        nxt = np.asarray(jax.device_get(nxt))
+                        toks_all.append(nxt)
+                        if n:
+                            self.cache.advance(slots, 1)
+                        if si + 1 < nsteps:
+                            # host-chained window (pure decode): feed
+                            # each slot's sampled token back as the
+                            # next input
+                            ids[:n] = nxt[:n]
+                            positions[:n] += 1
+                            kv_len[:n] += 1
         finally:
+            span.set_attr("steps_done", steps_done)
             span.end()
         dt_win = time.perf_counter() - t_win
+        self.last_window_steps = steps_done
 
         out = {}
         for i, req in enumerate(batch):
             new_toks = []
-            for j in range(nsteps):
+            for j in range(steps_done):
                 if req.done:
                     break
                 tok = int(toks_all[j][i])
@@ -1312,6 +1683,9 @@ class LLMEngine:
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
+        # decode tokens DELIVERED this window (prefill-completing first
+        # tokens are TTFT, appended to `out` below, never TPOT)
+        delivered = max((len(v) for v in out.values()), default=0)
 
         # prefill bookkeeping AFTER the dispatch succeeded — a raise
         # above leaves every pf_pos where it was (no token lost)
@@ -1338,10 +1712,17 @@ class LLMEngine:
                 self.cache.release(req.slot)
             else:
                 self._active.append(req)
-        _health.get_health().observe_tpot(dt_win / nsteps, n=nsteps)
+        # TPOT over-count fix: only DELIVERED decode positions advance
+        # the histogram / SLO window — a window whose requests all
+        # finished early contributes its real token count, not nsteps;
+        # pure-prefill steps contribute nothing (their latency is TTFT)
+        if delivered:
+            _health.get_health().observe_tpot(dt_win / steps_done,
+                                              n=delivered)
         if self._metrics is not None:
             m = self._metrics
-            m["tpot"].observe(dt_win / nsteps, n=nsteps)
+            if delivered:
+                m["tpot"].observe(dt_win / steps_done, n=delivered)
             m["generated_tokens"].inc(
                 sum(len(v) for v in out.values()))
             m["queue_depth"].set(len(self._active))
@@ -1674,19 +2055,38 @@ class LLMEngine:
         multi-step decode program's window buckets PLUS the unified
         mixed-step program (the unified path's only decode program —
         counted here so existing >=1 / unchanged-across-runs checks
-        keep holding on either path)."""
+        keep holding on either path) PLUS the scanned on-device window
+        programs — a window-bucket recompile must trip the same
+        unchanged-across-runs assertions the host-chained programs
+        live under."""
         return _paged_decode_step._cache_size() + \
-            _paged_mixed_step._cache_size()
+            _paged_mixed_step._cache_size() + \
+            LLMEngine.window_compiles()
 
     @staticmethod
     def mixed_compiles() -> int:
-        """Distinct compiled unified mixed-step programs — 1 per
-        engine geometry for ANY interleaving of prefill chunks and
-        decode slots (every batch-mix input is traced data).  Like the
-        other counters this reads a process-global jit cache: assert
-        deltas, not absolutes, when several geometries share the
-        process."""
-        return _paged_mixed_step._cache_size()
+        """Distinct compiled unified-path programs: the mixed-step
+        program (1 per engine geometry for ANY interleaving of prefill
+        chunks and decode slots — every batch-mix input is traced
+        data) plus, with ``scan_decode``, one mixed-window program per
+        power-of-two window bucket — bounded by the CompileWatch
+        allowances declared at engine construction
+        (bit_length(steps_per_sync) − 1 buckets).  Like the other
+        counters this reads a process-global jit cache: assert deltas,
+        not absolutes, when several geometries share the process."""
+        return _paged_mixed_step._cache_size() + \
+            _paged_mixed_window._cache_size()
+
+    @staticmethod
+    def window_compiles() -> int:
+        """Distinct compiled ON-DEVICE decode-window programs (both
+        paths' while_loop windows).  Expected: one per power-of-two
+        window bucket actually dispatched — {2, 4, ...,
+        2^floor(log2(steps_per_sync))} at most; 0 when scan_decode is
+        off or steps_per_sync == 1 (the degenerate window IS the plain
+        step program)."""
+        return _paged_decode_window._cache_size() + \
+            _paged_mixed_window._cache_size()
 
     def metrics_snapshot(self) -> dict:
         """One JSON-able dict with everything an operator tunes
@@ -1702,7 +2102,10 @@ class LLMEngine:
             "prefill_compiles": self.prefill_compiles(),
             "decode_compiles": self.decode_compiles(),
             "mixed_compiles": self.mixed_compiles(),
+            "window_compiles": self.window_compiles(),
             "unified_step": self.unified_step,
+            "scan_decode": self.scan_decode,
+            "last_window_steps": int(self.last_window_steps),
             "prefill_token_budget": int(self.prefill_token_budget),
             "kv_cache": self.cache.metrics_snapshot(),
             "kv_page_utilization": self.cache.page_utilization(),

@@ -1,0 +1,59 @@
+"""Decode-window sampling-key contract.
+
+The engine's decode window forks one subkey off the engine key per
+window (``self._key, sub = jax.random.split(self._key)``) and then
+chains INSIDE the window: every step splits the window key once and
+samples with the subkey.  The on-device scanned window
+(``scan_decode=True``) must reproduce the host-chained token stream
+bit for bit, which reduces to reproducing this exact key sequence —
+``jax.random.split`` is deterministic, so "same splits in the same
+order" IS the whole contract.
+
+This module is the single home of that derivation: the host-chained
+step, the ``lax.scan``/``while_loop`` window bodies, and the tests all
+derive step keys through ``split_step``, so a drive-by "optimization"
+(folding in a step index, splitting n keys up front, reordering the
+split against the sample) cannot silently fork the two paths.  Note
+what the contract is NOT: keys are not indexed by ABSOLUTE step number
+— step j of a window uses the j-th split of the WINDOW key, so early
+exit inside a window (all rows done) skips splits without perturbing
+the engine key, exactly like the host path which simply stops calling
+``step()``.
+
+``sample_logits`` is re-exported so window bodies import their whole
+sampling surface from one place.
+"""
+from __future__ import annotations
+
+from ..nn.generation import sample_logits
+
+__all__ = ["split_step", "window_keys", "sample_logits"]
+
+
+def split_step(key):
+    """One decode step's key derivation: ``(next_key, step_subkey)``.
+
+    Exactly ``jax.random.split(key)`` unpacked — kept as THE single
+    definition so host-chained dispatch and the scanned window bodies
+    cannot drift.  Traceable (used inside jit/scan/while bodies) and
+    callable eagerly (tests, host admission path).
+    """
+    import jax
+
+    next_key, sub = jax.random.split(key)
+    return next_key, sub
+
+
+def window_keys(key, n_steps: int):
+    """Host-side mirror of an ``n_steps`` window's key sequence:
+    ``([sub_0, ..., sub_{n_steps-1}], final_key)``.
+
+    Reference oracle for tests that pin the scanned window's sampling
+    draws against manual chaining; the engine itself never calls this
+    (its windows derive keys step by step via ``split_step``).
+    """
+    subs = []
+    for _ in range(int(n_steps)):
+        key, sub = split_step(key)
+        subs.append(sub)
+    return subs, key

@@ -66,8 +66,10 @@ from .vma import out_sds
 __all__ = ["paged_attention_raw", "paged_attention_reference",
            "paged_write", "paged_write_quant",
            "paged_decode_append_attend",
+           "paged_decode_append_attend_raw",
            "paged_decode_append_attend_reference",
            "ragged_paged_append_attend",
+           "ragged_paged_append_attend_raw",
            "ragged_paged_append_attend_reference",
            "paged_write_rows", "paged_write_rows_quant"]
 
@@ -398,13 +400,10 @@ def _decode_append_kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
         c.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("scale",),
-                   donate_argnames=("k_pages", "v_pages",
-                                    "k_scales", "v_scales"))
-def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
-                               page_table, seq_lens,
-                               k_scales=None, v_scales=None, *,
-                               scale=None):
+def paged_decode_append_attend_raw(q, k_pages, v_pages, k_new, v_new,
+                                   page_table, seq_lens,
+                                   k_scales=None, v_scales=None, *,
+                                   scale=None):
     """Fused decode step: append ``k_new``/``v_new`` [B, KVH, D] at
     position ``seq_lens[b]`` AND attend ``q`` [B, H, D] over the
     ``seq_lens[b] + 1`` tokens, in ONE kernel.
@@ -502,6 +501,18 @@ def paged_decode_append_attend(q, k_pages, v_pages, k_new, v_new,
         return out.reshape(b, h, d), kp, vp, ks, vs
     out, kp, vp = outs
     return out.reshape(b, h, d), kp, vp
+
+
+# standalone dispatch entry; the ``_raw`` body above stays callable
+# from INSIDE an enclosing jit (the engine's on-device decode-window
+# programs trace it per scan step — the pallas_call's
+# input_output_aliases keep the pools in-place across the carry either
+# way, while a nested jit here would only add a dispatch-cache entry
+# per enclosing program)
+paged_decode_append_attend = functools.partial(
+    jax.jit, static_argnames=("scale",),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"),
+)(paged_decode_append_attend_raw)
 
 
 def paged_decode_append_attend_reference(q, k_pages, v_pages, k_new,
@@ -879,13 +890,10 @@ def _ragged_kernel(qs_ref, ql_ref, kl_ref, pt_ref, q_hbm, kn_hbm,
             c.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("scale",),
-                   donate_argnames=("k_pages", "v_pages",
-                                    "k_scales", "v_scales"))
-def ragged_paged_append_attend(q, k_pages, v_pages, k_new, v_new,
-                               q_start, q_len, kv_len, page_tables,
-                               k_scales=None, v_scales=None, *,
-                               scale=None):
+def ragged_paged_append_attend_raw(q, k_pages, v_pages, k_new, v_new,
+                                   q_start, q_len, kv_len, page_tables,
+                                   k_scales=None, v_scales=None, *,
+                                   scale=None):
     """Ragged mixed prefill+decode step: ONE kernel appends and attends
     every descriptor of a flat token batch.
 
@@ -995,6 +1003,15 @@ def ragged_paged_append_attend(q, k_pages, v_pages, k_new, v_new,
         return out.reshape(s_max, P, h, d), kp, vp, ks, vs
     out, kp, vp = outs
     return out.reshape(s_max, P, h, d), kp, vp
+
+
+# standalone dispatch entry / in-graph body split, same contract as
+# ``paged_decode_append_attend``: the engine's scanned mixed-window
+# program calls the ``_raw`` form once per on-device step
+ragged_paged_append_attend = functools.partial(
+    jax.jit, static_argnames=("scale",),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"),
+)(ragged_paged_append_attend_raw)
 
 
 def paged_write_rows(k_pages, v_pages, k_new, v_new, positions,

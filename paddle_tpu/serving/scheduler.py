@@ -404,7 +404,22 @@ class Scheduler:
         step window, retire finished requests.  Returns
         ``{rid: [new tokens]}`` for this call (admission's prefill
         token included) — the same streaming contract as
-        ``LLMEngine.step``."""
+        ``LLMEngine.step``.
+
+        Window-boundary contract: ``engine.step()`` is where control
+        returns to the host, so EVERYTHING scheduler-shaped — admission
+        of waiters, preemption/suspend, migrate-out, abort, the AIMD
+        budget decision below — lands BETWEEN decode windows, never
+        inside one.  With the engine's on-device windows
+        (``scan_decode``, steps_per_sync > 1) a window is one compiled
+        dispatch of up to steps_per_sync tokens per request; the engine
+        returns the full per-request token lists for the window, so the
+        streaming contract, retirement, and the PR 5/6/10 bit-exactness
+        guarantees (suspend→resume, migration, preemption) are
+        unchanged — a request suspended here was never mid-window by
+        construction.  This is also why ``self._lock`` wrapping one
+        ``engine.step()`` is sufficient synchronization: there is no
+        finer-grained engine state to race with."""
         events: List = []
         out: Dict[object, List[int]] = {}
         with self._lock:
@@ -440,9 +455,13 @@ class Scheduler:
         """AIMD on the engine's runtime ``prefill_token_budget``
         (chunked_prefill + decode_tpot_slo only).  ``dt`` is the wall
         time of one engine step window; divided by the window's token
-        count it approximates decode TPOT — mixed windows are single
-        dispatches (nsteps == 1) so the approximation is exact where
-        the knob matters.  Breach: halve (floor 1 — the engine's own
+        count it approximates decode TPOT.  Windows with prefill
+        packed are single dispatches (nsteps == 1) so the
+        approximation is exact where the knob matters; scanned
+        multi-token windows (``scan_decode``) divide by the tokens the
+        window actually delivered — the max over
+        ``len(step_out[rid])`` — so an early-exited window is costed
+        by its real length.  Breach: halve (floor 1 — the engine's own
         livelock guard still guarantees prefill progress on
         prefill-only steps).  Under SLO: recover one page per step up
         to the configured ceiling (``engine._pf_budget_static``)."""
