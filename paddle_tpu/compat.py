@@ -1,0 +1,54 @@
+"""Version-tolerance shims for the jax API surface.
+
+The distributed layers (pipeline 1F1B, expert/context parallel, the
+pallas spmd wrappers) target the promoted ``jax.shard_map`` API —
+``axis_names`` selects the manual mesh axes and ``check_vma`` toggles
+the varying-mesh-axes checker.  Older jax releases only ship
+``jax.experimental.shard_map.shard_map`` with the ancestral spelling:
+``auto`` names the NON-manual axes and the checker is ``check_rep``.
+``shard_map`` here dispatches to whichever the interpreter provides so
+one call site works on both; everything in-repo goes through it.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["axis_size", "shard_map"]
+
+
+def axis_size(name):
+    """``jax.lax.axis_size`` when available, else the ``psum(1, name)``
+    spelling older jax understands (same compile-time constant)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return lax.psum(1, name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None):
+    """``jax.shard_map`` when available, else the experimental API.
+
+    ``axis_names`` is the promoted-API meaning: the set of mesh axes the
+    body is manual over (None = all of them).  On the experimental
+    fallback it is translated to ``auto`` (its complement w.r.t. the
+    mesh) and ``check_vma`` to ``check_rep``; ``check_rep`` defaults OFF
+    there because partial-auto meshes predate reliable replication
+    checking in that API.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, auto=auto,
+                      check_rep=bool(check_vma) if check_vma is not None
+                      else False)

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from ..compat import shard_map as _compat_shard_map
+from ..compat import axis_size as _compat_axis_size
 
 from ..common.flags import define_flag, get_flag
 
@@ -149,7 +151,7 @@ def _rotate(tree, axis_name: str, n: int):
 # ---------------------------------------------------------------------------
 
 def _ring_fwd_impl(q, k, v, axis_name: str, causal: bool):
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -269,7 +271,7 @@ def _chunk_bwd(q, kc, vc, out, lse, do, diag: bool, q_off, k_off,
 
 def _ring_bwd_rule(axis_name, causal, res, do):
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
@@ -426,7 +428,7 @@ def _mapped(mesh, impl: str, causal: bool, manual: frozenset, spec):
     fn = {"ring": ring_attention_local,
           "ulysses": ulysses_attention_local}[impl]
     body = functools.partial(fn, axis_name="sep", causal=causal)
-    mapped = jax.shard_map(
+    mapped = _compat_shard_map(
         lambda q_, k_, v_: body(q_, k_, v_),
         mesh=mesh, axis_names=manual,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)

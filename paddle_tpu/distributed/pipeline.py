@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ..compat import shard_map as _compat_shard_map
+from ..compat import axis_size as _compat_axis_size
 
 from ..common.errors import enforce
 from ..nn.layer import Layer
@@ -64,7 +66,9 @@ def _pvary(x, axis):
         return x
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis,))
+    return x   # pre-vma jax: no varying bookkeeping to maintain
 
 
 def _mesh_platform(mesh) -> str:
@@ -120,7 +124,7 @@ def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
         locals_ = [p[0] for p in params_local]
         n_micro = xm.shape[0]
         stage = jax.lax.axis_index(pp_axis)
-        nstage = jax.lax.axis_size(pp_axis)
+        nstage = _compat_axis_size(pp_axis)
         v = n_virtual
         rounds = -(-n_micro // nstage) if v > 1 else 1
         total = (rounds * v * nstage + nstage - 1) if v > 1 \
@@ -195,7 +199,9 @@ def _jitted_pipeline(stage_fn: Callable, mesh, pp_axis: str,
     in_specs = (tuple(P(pp_axis) for _ in range(n_params)), P(),
                 *(P() for _ in range(n_extra + n_tail_params + n_tail_idx)))
     out_specs = P() if tail_fn is not None else P(pp_axis)
-    mapped = jax.shard_map(inner, mesh=mesh, axis_names={pp_axis},
+    manual = ({pp_axis} if hasattr(jax, "shard_map")
+              else set(mesh.axis_names))
+    mapped = _compat_shard_map(inner, mesh=mesh, axis_names=manual,
                            in_specs=in_specs, out_specs=out_specs)
     # jit wrapper: eager evaluation of checkpoint/scan inside shard_map is
     # unsupported; under an outer jit this inlines
@@ -675,7 +681,9 @@ def _jitted_1f1b(stage_fn: Callable, tail_fn: Callable, mesh,
                                      + n_tail_idx)))
     out_specs = (P(), P(), tuple(P(pp_axis) for _ in range(n_params)),
                  P(), tuple(P() for _ in range(n_tail_params)))
-    mapped = jax.shard_map(inner, mesh=mesh, axis_names={pp_axis},
+    manual = ({pp_axis} if hasattr(jax, "shard_map")
+              else set(mesh.axis_names))
+    mapped = _compat_shard_map(inner, mesh=mesh, axis_names=manual,
                            in_specs=in_specs, out_specs=out_specs)
     return jax.jit(mapped)
 

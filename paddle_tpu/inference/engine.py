@@ -91,10 +91,12 @@ import numpy as np
 
 from ..common.errors import enforce
 from ..observability import get_registry
+from ..observability import capsule as _capsule
 from ..observability import health as _health
 from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
 from ..profiler import RecordEvent
+from . import sampling as _sampling
 from .paged_cache import PagedKVCache
 
 __all__ = ["LLMEngine", "GenRequest"]
@@ -932,6 +934,27 @@ class LLMEngine:
         # /memz row; weakly held so a released engine frees its pages
         _insp.register_memory_consumer(
             f"kv_cache:{self.engine_id}", self.cache)
+        # request-capsule config fingerprint: everything a replay needs
+        # to decide "same engine config" — cheap dict built once, the
+        # model hash is a config hash (never a weight sync)
+        self._capsule_fp = {
+            "engine": self.engine_id,
+            "model_hash": _capsule.model_fingerprint(model),
+            "kv_dtype": kv_dtype, "weight_dtype": weight_dtype,
+            "page_size": page_size, "n_pages": int(n_pages),
+            "max_seqs": max_seqs, "max_len": max_len,
+            "steps_per_sync": steps_per_sync,
+            "unified_step": self.unified_step,
+            "scan_decode": self.scan_decode,
+            "decode_strategy": decode_strategy,
+            "top_k": self.top_k, "top_p": self.top_p,
+            "temperature": self.temperature, "seed": seed,
+            "prefix_caching": self.enable_prefix_caching,
+        }
+
+    def config_fingerprint(self) -> dict:
+        """This engine's capsule config fingerprint (copy)."""
+        return dict(self._capsule_fp)
 
     # -- metrics ---------------------------------------------------------------
     def _init_metrics(self, enabled: bool):
@@ -1228,6 +1251,18 @@ class LLMEngine:
         st["miss_tokens"] += plen - cached
         st["shared_pages"] += len(shared_pages)
         st["hit_requests" if cached else "miss_requests"] += 1
+        # capsule capture (one global read; no-op on the NULL store):
+        # the admission subkey IS the key anchor — replay re-samples
+        # the first token with exactly these words
+        cs = _capsule.get_capsule_store()
+        if cs.enabled:
+            cs.begin(rid, prompt=list(req.prompt),
+                     max_new=req.max_new, eos=req.eos,
+                     fingerprint=self._capsule_fp,
+                     key_anchor=_sampling.key_fingerprint(sub),
+                     prefix={"hit_tokens": int(cached),
+                             "shared_pages": len(shared_pages)},
+                     tokens=[first])
         # the int() above synced the device: TTFT is honest
         ttft = time.perf_counter() - t_admit
         _health.get_health().observe_ttft(ttft)
@@ -1295,6 +1330,17 @@ class LLMEngine:
         req.t_submit = time.perf_counter()
         self.requests[rid] = req
         self._prefilling.append(req)
+        # capsule capture: no key anchor on the deferred path — the
+        # first token arrives inside a later mixed window, whose key
+        # the window record carries like any other step's
+        cs = _capsule.get_capsule_store()
+        if cs.enabled:
+            cs.begin(rid, prompt=list(req.prompt),
+                     max_new=req.max_new, eos=req.eos,
+                     fingerprint=self._capsule_fp, key_anchor=None,
+                     prefix={"hit_tokens": int(cached),
+                             "shared_pages": len(shared_pages)},
+                     tokens=[])
         st = self.prefix_stats
         st["hit_tokens"] += cached
         st["miss_tokens"] += plen - cached
@@ -1450,6 +1496,16 @@ class LLMEngine:
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
+        # capsule capture: one window record per captured rid — the
+        # forked window key anchors the in-window split_step chain, so
+        # replay reproduces the draws key for key
+        cs = _capsule.get_capsule_store()
+        if cs.enabled and out:
+            cs.on_window(out, _sampling.key_fingerprint(sub), nsteps,
+                         steps_done,
+                         "decode_window"
+                         if self.scan_decode and nsteps > 1
+                         else "decode_step")
         # TPOT counts only tokens actually DELIVERED to a stream: a
         # request that retired mid-window stops contributing positions
         # (the fixed window-boundary over-count), and the window's
@@ -1712,6 +1768,17 @@ class LLMEngine:
                 self.cache.release(req.slot)
             else:
                 self._active.append(req)
+        # capsule capture after the finishing loop, so prefill-
+        # completing first tokens ride the same window record as the
+        # decode tokens (the forked key `sub` anchors the whole
+        # window's split_step chain, host-chained or scanned)
+        cs = _capsule.get_capsule_store()
+        if cs.enabled and out:
+            cs.on_window(out, _sampling.key_fingerprint(sub), nsteps,
+                         steps_done,
+                         "mixed_window"
+                         if self.scan_decode and nsteps > 1
+                         else "mixed_step")
         # TPOT over-count fix: only DELIVERED decode positions advance
         # the histogram / SLO window — a window whose requests all
         # finished early contributes its real token count, not nsteps;
@@ -1807,6 +1874,9 @@ class LLMEngine:
             sp.set_attr("armed", req.swap_handle is not None)
         req.slot = None
         req.suspended = True
+        _capsule.get_capsule_store().event(
+            rid, "suspend:swap" if req.swap_handle is not None
+            else "suspend:drop")
         if self._metrics is not None:
             self._metrics["suspended"].inc()
             self._metrics["queue_depth"].set(len(self._active))
@@ -1869,6 +1939,7 @@ class LLMEngine:
         req.slot = slot
         req.suspended = False
         self._active.append(req)
+        _capsule.get_capsule_store().event(rid, f"resume:{path}")
         if self._metrics is not None:
             self._metrics["resumed"].labels(self.engine_id, path).inc()
             self._metrics["queue_depth"].set(len(self._active))
@@ -1926,9 +1997,14 @@ class LLMEngine:
         del self.requests[rid]
         if self._metrics is not None:
             self._metrics["migrated_out"].inc()
+        # the request's capsule travels INSIDE the package (plain
+        # JSON; transports ship it untouched) so a drained request's
+        # capture history stays whole on the destination replica
+        cs = _capsule.get_capsule_store()
         return {"rid": rid, "prompt": list(req.prompt),
                 "out": list(req.out), "max_new": req.max_new,
-                "eos": req.eos, "swap": blob}
+                "eos": req.eos, "swap": blob,
+                "capsule": cs.export(rid) if cs.enabled else None}
 
     def import_request(self, pkg: dict):
         """Adopt a migration package: the request registers here in
@@ -1965,6 +2041,9 @@ class LLMEngine:
         req.suspended = True
         req.swap_handle = self.cache.import_swap(pkg.get("swap"))
         self.requests[rid] = req
+        cs = _capsule.get_capsule_store()
+        if cs.enabled and pkg.get("capsule"):
+            cs.adopt(pkg["capsule"])
         if self._metrics is not None:
             self._metrics["migrated_in"].inc()
         return rid

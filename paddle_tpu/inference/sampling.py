@@ -27,7 +27,8 @@ from __future__ import annotations
 
 from ..nn.generation import sample_logits
 
-__all__ = ["split_step", "window_keys", "sample_logits"]
+__all__ = ["split_step", "window_keys", "key_fingerprint",
+           "key_from_fingerprint", "sample_logits"]
 
 
 def split_step(key):
@@ -42,6 +43,33 @@ def split_step(key):
 
     next_key, sub = jax.random.split(key)
     return next_key, sub
+
+
+def key_fingerprint(key):
+    """Portable record of a PRNG key: its raw uint32 words as a plain
+    int list (JSON-able — request capsules carry window keys across
+    replicas in migration packages and spill files).  Inverse of
+    ``key_from_fingerprint``: round-tripping a key and splitting it
+    reproduces the original split chain exactly, because the words ARE
+    the key's whole state."""
+    import jax
+    import numpy as np
+
+    try:
+        words = jax.random.key_data(key)
+    except (AttributeError, TypeError):
+        words = key  # legacy raw uint32-vector key
+    return [int(w) for w in np.asarray(words).ravel()]
+
+
+def key_from_fingerprint(words):
+    """Rebuild a decode-window key from ``key_fingerprint`` output.
+    Returns the legacy uint32-vector form, which every sampling entry
+    point in this repo accepts (``jax.random`` treats it as a
+    threefry2x32 key)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(list(words), dtype=jnp.uint32)
 
 
 def window_keys(key, n_steps: int):

@@ -35,6 +35,19 @@ Three pieces, all stdlib-only:
   ``get_compile_watch()`` returns the shared ``NULL_COMPILE_WATCH``
   singleton, and ``watched_call`` tail-calls the jit function off one
   module-global read.
+- :mod:`~paddle_tpu.observability.capsule` — the capture/replay
+  plane: ``CapsuleStore`` records per-request **capsules** (prompt,
+  sampling params, engine config fingerprint, the decode-window key
+  chain, prefix-hit extents, lifecycle timeline) with triggered
+  persistence on slow TTFT / deadline miss / error / sentinel trip;
+  ``replay_capsule`` re-runs a capsule through a fresh engine via the
+  same compiled programs and diffs the token stream (bit-exact on
+  every engine path), and ``divergence_audit`` replays sampled
+  capsules cross-replica as a continuous correctness canary, served
+  as ``GET /capsulez`` / ``GET /v1/capsule`` / ``POST /v1/replay``
+  and federated through ``/fleetz``.  Same disabled-is-free contract:
+  ``get_capsule_store()`` returns the shared ``NULL_CAPSULE_STORE``
+  singleton off one module-global read.
 
 Serving instrumentation (TTFT/TPOT histograms, token counters, KV-page
 gauges, compile-count gauges) lives with the instrumented code in
@@ -63,6 +76,11 @@ from .introspection import (CompileWatch, RecompileError,
                             disable_compile_watch, enable_compile_watch,
                             get_compile_watch, register_memory_consumer,
                             watched_call)
+from .capsule import (CapsuleStore, NULL_CAPSULE_STORE,
+                      disable_capsule_capture, divergence_audit,
+                      enable_capsule_capture, get_capsule_store,
+                      replay_capsule)
+from . import capsule
 from . import health
 from . import introspection
 from . import tracing
@@ -80,4 +98,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
            "RecompileError", "enable_compile_watch",
            "disable_compile_watch", "get_compile_watch",
            "watched_call", "register_memory_consumer",
-           "introspection"]
+           "introspection", "CapsuleStore", "NULL_CAPSULE_STORE",
+           "enable_capsule_capture", "disable_capsule_capture",
+           "get_capsule_store", "replay_capsule", "divergence_audit",
+           "capsule"]

@@ -1,0 +1,679 @@
+"""Request capsules: deterministic capture, bit-exact replay, and the
+divergence audit plane.
+
+The repo's defining invariant — tokens bit-identical across every
+engine path (fp/int8 KV, prefix hits, preempt→resume, migration,
+scanned windows) — is asserted in tests but was invisible in
+production: when a served request went wrong (garbage output, slow
+TTFT, sentinel trip) there was no way to REPRODUCE it.  This plane
+turns the invariant into a live debugging tool:
+
+* ``CapsuleStore`` — a bounded ring (flight-recorder style, oldest
+  evicted) of per-request **capsules**: prompt token ids, sampling
+  params, the engine's config FINGERPRINT (kv_dtype, page geometry,
+  steps_per_sync, unified/scan flags, model hash), the engine-stream
+  KEY ANCHOR (the admission subkey forked off the engine key) and the
+  per-window keys of the ``inference.sampling`` ``split_step`` chain,
+  prefix-cache hit extents, the full delivered token stream, and the
+  scheduler lifecycle timeline.  Triggered captures (slow TTFT,
+  deadline miss, error, AnomalySentinel trip) are ``persist``-ed:
+  spilled to a JSONL file when configured and pinned against ring
+  eviction accounting, with the trace_id cross-link so the operator
+  path is statusz → capsule → replay.
+
+* ``replay_capsule(capsule, engine)`` — re-runs the request through a
+  fresh engine via the SAME compiled machinery the original run used
+  (``_prefill_seq`` chunks, ``_paged_decode_step`` windows dispatched
+  through the CompileWatch's declared ``engine.decode_step`` entry)
+  and returns a per-step diff report: first divergent step, expected
+  vs got token, optional logprob delta at the divergence.  Greedy
+  replay is bit-exact BY CONSTRUCTION on fp and int8 KV, across
+  unified×scan grids, and after migration (same programs, same
+  inputs ⇒ same argmax).  Sampling replay re-uses the RECORDED window
+  keys; note ``jax.random.categorical`` draws are row-position
+  sensitive, so sampling replay is exact only for captures that ran
+  at row 0 (single-request canaries — exactly the audit workload).
+
+* ``divergence_audit(engine)`` — replays N deterministically-sampled
+  complete capsules (continuous cross-replica correctness canarying:
+  capture on replica A, audit on replica B) and folds the verdict
+  into the store snapshot, which rides ``metrics_snapshot()`` and
+  federates through the router's ``fleet_snapshot()``.
+
+Disabled-is-free contract, same as the tracer / health / compile-watch
+planes: capture sites cost ONE module-global read returning the shared
+``NULL_CAPSULE_STORE`` singleton (identity-asserted in tests) whose
+methods are no-ops; with capture ON, tokens stay bit-identical and
+compile counts unchanged (capture only OBSERVES the step — it never
+touches the engine key or dispatches anything).
+
+This module imports jax and the inference tier LAZILY (inside
+functions): the observability package must stay importable before —
+and independently of — the engine it observes.
+"""
+from __future__ import annotations
+
+import copy
+import hashlib
+import itertools
+import json
+import random
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from ..common.errors import enforce
+
+__all__ = [
+    "CapsuleStore", "NULL_CAPSULE_STORE", "enable_capsule_capture",
+    "disable_capsule_capture", "get_capsule_store",
+    "model_fingerprint", "replay_capsule", "divergence_audit",
+]
+
+# fingerprint keys that can change the TOKEN STREAM itself — a replay
+# across engines differing here is cross-CONFIG, reported as
+# ``fingerprint_mismatch`` (the audit still runs: divergence is then
+# expected information, not a bug).  Engine id / seed / pool sizes are
+# deliberately absent: replicas differ there by design and must still
+# replay bit-exact.
+_TOKEN_AFFECTING = (
+    "model_hash", "kv_dtype", "weight_dtype", "page_size",
+    "decode_strategy", "top_k", "top_p", "temperature",
+)
+
+
+def model_fingerprint(model) -> str:
+    """Cheap content hash of a model's architecture config — enough to
+    tell "replayed on a different model" from "same model, divergent
+    math".  Hashes the config dict (sorted) rather than the weights:
+    weight hashing would device-sync megabytes per engine build, and a
+    config collision with different weights still shows up as a token
+    divergence, which is what the replay report is for."""
+    try:
+        items = sorted(
+            (k, repr(v)) for k, v in vars(model.config).items())
+    except TypeError:
+        items = [("config", repr(model.config))]
+    h = hashlib.sha256(repr(items).encode()).hexdigest()[:16]
+    return h
+
+
+class _NullCapsuleStore:
+    """Shared no-op singleton returned while capture is disabled: the
+    engine/scheduler capture sites pay one global read + one attribute
+    check and nothing else.  ``__slots__ = ()`` keeps it stateless so
+    the identity assert (``get_capsule_store() is NULL_CAPSULE_STORE``)
+    is also a no-leak assert."""
+    __slots__ = ()
+    enabled = False
+    slow_ttft: Optional[float] = None
+
+    def begin(self, rid, **kw):
+        pass
+
+    def on_window(self, out, key_words, n_steps, steps_done, path):
+        pass
+
+    def annotate(self, rid, timeline=None, trace_id=None,
+                 complete=False):
+        pass
+
+    def event(self, rid, name):
+        pass
+
+    def persist(self, rid, reason):
+        return None
+
+    def capsule_id(self, rid):
+        return None
+
+    def get(self, rid):
+        return None
+
+    def export(self, rid):
+        return None
+
+    def adopt(self, capsule):
+        return None
+
+    def sample_complete(self, n, seed=0):
+        return []
+
+    def record_replay(self, report):
+        pass
+
+    def record_audit(self, summary):
+        pass
+
+    def snapshot(self):
+        return {"enabled": False}
+
+    def capsulez(self):
+        return {"enabled": False}
+
+
+NULL_CAPSULE_STORE = _NullCapsuleStore()
+
+
+class CapsuleStore:
+    """Bounded ring of request capsules + JSONL spill for persisted
+    (triggered) captures.  Thread-safe: capture sites run on the
+    scheduler's stepping thread, endpoints and audits on HTTP handler
+    threads."""
+    enabled = True
+
+    def __init__(self, capacity: int = 256,
+                 spill_path: Optional[str] = None,
+                 slow_ttft: Optional[float] = None):
+        enforce(capacity >= 1, "capsule capacity must be >= 1")
+        self._lock = threading.RLock()
+        self._ring: "OrderedDict[object, dict]" = OrderedDict()
+        self._seq = itertools.count(1)
+        self.capacity = int(capacity)
+        self.spill_path = spill_path
+        # store-level slow-TTFT threshold (seconds): schedulers /
+        # frontends without their own knob trigger-capture past it
+        self.slow_ttft = slow_ttft
+        self._audits: deque = deque(maxlen=8)
+        self.counters = {"captured_total": 0, "persisted_total": 0,
+                         "evicted_total": 0, "adopted_total": 0,
+                         "replays_total": 0, "divergent_replays_total": 0}
+
+    # -- capture ---------------------------------------------------------------
+    def begin(self, rid, *, prompt, max_new, eos, fingerprint,
+              key_anchor, prefix, tokens):
+        """Open a capsule at engine admission.  ``key_anchor`` is the
+        admission subkey's uint32 words (``add_request`` samples the
+        first token with it) or None on the deferred ``begin_request``
+        path, where the first token rides a later window's key chain
+        like every other token."""
+        cap = {
+            "cap_id": None, "rid": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new": int(max_new),
+            "eos": None if eos is None else int(eos),
+            "fingerprint": dict(fingerprint),
+            "key_anchor": key_anchor,
+            "prefix": dict(prefix or {}),
+            "windows": [], "tokens": [int(t) for t in tokens],
+            "timeline": [], "trace_id": None,
+            "events": [], "persist_reasons": [],
+            "complete": False, "t_created": time.time(),
+        }
+        with self._lock:
+            cap["cap_id"] = f"c{next(self._seq)}"
+            self._ring[rid] = cap
+            self._ring.move_to_end(rid)
+            self.counters["captured_total"] += 1
+            while len(self._ring) > self.capacity:
+                self._ring.popitem(last=False)
+                self.counters["evicted_total"] += 1
+
+    def on_window(self, out: Dict[object, List[int]], key_words,
+                  n_steps: int, steps_done: int, path: str):
+        """Record one decode window for every captured rid it
+        delivered tokens to: the window's forked key (the anchor of
+        its in-window ``split_step`` chain), the STATIC dispatch size
+        ``n_steps``, the early-exit ``steps_done``, how many tokens
+        THIS rid took from it, and which compiled path ran.  The
+        delivered tokens extend the capsule's stream — the capsule
+        always mirrors ``req.out``."""
+        with self._lock:
+            for rid, toks in out.items():
+                cap = self._ring.get(rid)
+                if cap is None:
+                    continue
+                cap["windows"].append({
+                    "key": key_words, "n_steps": int(n_steps),
+                    "steps_done": int(steps_done),
+                    "n_toks": len(toks), "path": path})
+                cap["tokens"].extend(int(t) for t in toks)
+
+    def annotate(self, rid, timeline=None, trace_id=None,
+                 complete=False):
+        """Sync scheduler-side context into the capsule: the lifecycle
+        timeline (scheduler's is authoritative — synced at admission,
+        migration, and retirement rather than mirrored per event), the
+        trace_id cross-link, and completion."""
+        with self._lock:
+            cap = self._ring.get(rid)
+            if cap is None:
+                return
+            if timeline is not None:
+                cap["timeline"] = [[str(ev), float(t)]
+                                   for ev, t in timeline]
+            if trace_id is not None:
+                cap["trace_id"] = trace_id
+            if complete:
+                cap["complete"] = True
+
+    def event(self, rid, name: str):
+        """Engine/scheduler-side point event (suspend, resume path,
+        migration hops) appended to the capsule's own event list."""
+        with self._lock:
+            cap = self._ring.get(rid)
+            if cap is not None:
+                cap["events"].append([str(name), time.time()])
+
+    # -- triggered persistence -------------------------------------------------
+    def persist(self, rid, reason: str) -> Optional[str]:
+        """Triggered capture: pin the capsule with a reason and spill
+        it to the JSONL file (once — later triggers on the same
+        capsule only append their reason).  Returns the capsule id, or
+        None when nothing was captured for ``rid`` (capture enabled
+        after admission, evicted, or never admitted)."""
+        with self._lock:
+            cap = self._ring.get(rid)
+            if cap is None:
+                return None
+            first = not cap["persist_reasons"]
+            if reason not in cap["persist_reasons"]:
+                cap["persist_reasons"].append(str(reason))
+            if first:
+                self.counters["persisted_total"] += 1
+                if self.spill_path:
+                    try:
+                        with open(self.spill_path, "a") as f:
+                            f.write(json.dumps(cap, default=str))
+                            f.write("\n")
+                    except OSError:
+                        pass  # spill is best-effort; the ring copy
+                        # is the source of truth
+            return cap["cap_id"]
+
+    # -- access ----------------------------------------------------------------
+    def _lookup(self, rid):
+        """Ring lookup tolerant of rid representation: HTTP query
+        params and flight-recorder events carry rids as strings while
+        in-process callers may use the original (possibly int) key."""
+        cap = self._ring.get(rid)
+        if cap is None and isinstance(rid, str):
+            for k, c in self._ring.items():
+                if str(k) == rid:
+                    return c
+        return cap
+
+    def capsule_id(self, rid) -> Optional[str]:
+        with self._lock:
+            cap = self._lookup(rid)
+            return None if cap is None else cap["cap_id"]
+
+    def get(self, rid) -> Optional[dict]:
+        with self._lock:
+            cap = self._lookup(rid)
+            return None if cap is None else copy.deepcopy(cap)
+
+    def export(self, rid) -> Optional[dict]:
+        """Remove and return the capsule for a migrating request — it
+        travels INSIDE the migration package so a drained request's
+        capsule stays whole across replicas (it is plain JSON; the
+        transport ships it untouched)."""
+        with self._lock:
+            cap = self._ring.pop(rid, None)
+            if cap is not None:
+                cap["events"].append(["exported", time.time()])
+            return cap
+
+    def adopt(self, capsule: Optional[dict]):
+        """Adopt a migrated capsule on the destination store.  The
+        source's window records and key anchor come with it — replay
+        on the destination replays the WHOLE history, pre- and
+        post-migration tokens alike."""
+        if not isinstance(capsule, dict) or "rid" not in capsule:
+            return None
+        rid = capsule["rid"]
+        with self._lock:
+            capsule.setdefault("events", []).append(
+                ["adopted", time.time()])
+            self._ring[rid] = capsule
+            self._ring.move_to_end(rid)
+            self.counters["adopted_total"] += 1
+            while len(self._ring) > self.capacity:
+                self._ring.popitem(last=False)
+                self.counters["evicted_total"] += 1
+            return rid
+
+    def sample_complete(self, n: int, seed: int = 0) -> List[dict]:
+        """Deterministic sample of COMPLETE capsules (audit input):
+        same seed + same store contents → same sample, so a scheduled
+        audit is reproducible."""
+        with self._lock:
+            done = [copy.deepcopy(c) for c in self._ring.values()
+                    if c["complete"]]
+        if len(done) <= n:
+            return done
+        return random.Random(seed).sample(done, n)
+
+    # -- accounting ------------------------------------------------------------
+    def record_replay(self, report: dict):
+        with self._lock:
+            self.counters["replays_total"] += 1
+            if report.get("first_divergence") is not None:
+                self.counters["divergent_replays_total"] += 1
+
+    def record_audit(self, summary: dict):
+        with self._lock:
+            self._audits.append(copy.deepcopy(summary))
+
+    # -- exposition ------------------------------------------------------------
+    def _brief(self, cap: dict) -> dict:
+        return {"cap_id": cap["cap_id"], "rid": str(cap["rid"]),
+                "n_tokens": len(cap["tokens"]),
+                "n_windows": len(cap["windows"]),
+                "complete": cap["complete"],
+                "persist_reasons": list(cap["persist_reasons"]),
+                "trace_id": cap["trace_id"]}
+
+    def snapshot(self) -> dict:
+        """Summary block that rides ``metrics_snapshot()`` /
+        ``/statusz`` and federates through ``fleet_snapshot()``."""
+        with self._lock:
+            caps = list(self._ring.values())
+            return {"enabled": True, "live": len(caps),
+                    "capacity": self.capacity,
+                    "slow_ttft": self.slow_ttft,
+                    "spill_path": self.spill_path,
+                    **dict(self.counters),
+                    "audits": [copy.deepcopy(a) for a in self._audits],
+                    "recent": [self._brief(c) for c in caps[-10:]]}
+
+    def capsulez(self) -> dict:
+        """Full listing for ``GET /capsulez``."""
+        snap = self.snapshot()
+        with self._lock:
+            snap["capsules"] = [self._brief(c)
+                                for c in self._ring.values()]
+        return snap
+
+
+# -- module-global plumbing (one read on the hot path) -------------------------
+_STORE: Optional[CapsuleStore] = None
+
+
+def enable_capsule_capture(capacity: int = 256,
+                           spill_path: Optional[str] = None,
+                           slow_ttft: Optional[float] = None) -> CapsuleStore:
+    """Install the process-global CapsuleStore and return it.  Every
+    engine admission and decode window from here on is captured; the
+    scheduler's triggered-capture hooks persist on slow TTFT past
+    ``slow_ttft``, deadline miss, error, and sentinel trip."""
+    global _STORE
+    _STORE = CapsuleStore(capacity=capacity, spill_path=spill_path,
+                          slow_ttft=slow_ttft)
+    return _STORE
+
+
+def disable_capsule_capture():
+    """Drop the global store — capture sites fall back to the shared
+    NULL singleton (one global read, no-op methods)."""
+    global _STORE
+    _STORE = None
+
+
+def get_capsule_store():
+    """The process-global store, or ``NULL_CAPSULE_STORE`` when
+    capture is off — callers branch on ``.enabled`` and never
+    None-check."""
+    return NULL_CAPSULE_STORE if _STORE is None else _STORE
+
+
+# -- replay --------------------------------------------------------------------
+def _new_report(capsule: dict, engine) -> dict:
+    return {"cap_id": capsule.get("cap_id"),
+            "rid": str(capsule.get("rid")),
+            "engine": engine.engine_id,
+            "n_tokens": len(capsule.get("tokens") or []),
+            "steps_compared": 0, "first_divergence": None,
+            "expected": None, "got": None,
+            "logprob_expected": None, "logprob_got": None,
+            "logprob_delta": None,
+            "fingerprint_mismatch": [], "notes": []}
+
+
+def _token_logprobs(logits, *tokens):
+    """Log-probabilities of ``tokens`` under one logits row (f32 on
+    host — replay is a debug path, precision beats speed here)."""
+    import numpy as np
+
+    row = np.asarray(logits, np.float64).ravel()
+    row = row - row.max()
+    logz = float(np.log(np.exp(row).sum()))
+    return [float(row[t] - logz) for t in tokens]
+
+
+def _divergence(report, step, want, got, logits=None):
+    report["first_divergence"] = int(step)
+    report["expected"] = int(want)
+    report["got"] = int(got)
+    if logits is not None:
+        lp_want, lp_got = _token_logprobs(logits, want, got)
+        report["logprob_expected"] = lp_want
+        report["logprob_got"] = lp_got
+        report["logprob_delta"] = lp_got - lp_want
+
+
+def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
+                   store=None) -> dict:
+    """Re-run a captured request through ``engine`` and diff the token
+    stream step by step.
+
+    The replay goes through the SAME compiled entry points the live
+    run used — ``_prefill_seq`` page chunks and ``_paged_decode_step``
+    power-of-two windows dispatched via the CompileWatch's declared
+    ``engine.decode_step`` program — so a warm engine replays with
+    ZERO new compiles and the comparison is computation-vs-
+    computation, never reference-vs-computation.  Teacher forcing: the
+    input of every window is the last RECORDED token, so one divergent
+    step cannot cascade and the report pins the FIRST divergence
+    exactly.  The engine's sampling key is never touched (an engine
+    that replays stays bit-reproducible for its own live requests);
+    KV goes into a scratch slot that is released on every exit path.
+
+    Report: ``first_divergence`` (generated-token index, None ⇒
+    bit-exact), expected/got token, optional logprob delta at the
+    divergence (one extra prefill over the shared context), plus any
+    token-affecting ``fingerprint_mismatch`` between the capture and
+    this engine."""
+    import jax
+    import numpy as np
+
+    st = store if store is not None else get_capsule_store()
+    report = _new_report(capsule, engine)
+    fp = capsule.get("fingerprint") or {}
+    mine = getattr(engine, "config_fingerprint", lambda: {})()
+    report["fingerprint_mismatch"] = [
+        k for k in _TOKEN_AFFECTING
+        if k in fp and k in mine and fp[k] != mine[k]]
+    exp = [int(t) for t in capsule.get("tokens") or []]
+    if not exp:
+        report["notes"].append("no_tokens_recorded")
+        st.record_replay(report)
+        return report
+    prompt = [int(t) for t in capsule["prompt"]]
+    strategy = fp.get("decode_strategy", engine.decode_strategy)
+    if strategy != "greedy_search":
+        report["notes"].append("sampling_replay_row0_only")
+
+    from ..inference import engine as _eng
+    from ..inference import sampling as _sampling
+    from . import introspection as _insp
+
+    jnp = jax.numpy
+    # budget the scratch slot for the largest window overshoot (a
+    # recorded window's static n_steps can exceed the tokens this
+    # request took from it)
+    overshoot = max([w["n_steps"] for w in capsule.get("windows") or []]
+                    + [int(engine.steps_per_sync)])
+    slot = engine.cache.allocate(len(prompt) + len(exp) + overshoot)
+    try:
+        # full prefill, no prefix shortcut: replay must not depend on
+        # what the prefix index currently holds (hits only skip
+        # recompute of IDENTICAL pages, so running all chunks is the
+        # conservative bit-identical choice)
+        logits = engine._prefill_seq(slot, prompt, 0)
+        engine.cache.set_len(slot, len(prompt))
+        # first token: add_request capsules carry the admission subkey
+        # anchor; begin_request capsules produced their first token
+        # inside a window (handled by the window loop below)
+        anchored = capsule.get("key_anchor") is not None
+        i = 0
+        if anchored:
+            if strategy == "greedy_search":
+                first = int(np.asarray(jnp.argmax(logits)))
+            else:
+                sub = _sampling.key_from_fingerprint(
+                    capsule["key_anchor"])
+                tok, _ = _sampling.sample_logits(
+                    logits[None], sub, strategy=strategy,
+                    top_k=fp.get("top_k", engine.top_k),
+                    top_p=fp.get("top_p", engine.top_p),
+                    temperature=fp.get("temperature",
+                                       engine.temperature))
+                first = int(np.asarray(tok)[0])
+            report["steps_compared"] = 1
+            if first != exp[0]:
+                if logprobs:
+                    _divergence(report, 0, exp[0], first, logits)
+                else:
+                    _divergence(report, 0, exp[0], first)
+                st.record_replay(report)
+                return report
+            i = 1
+        # decode replay: greedy re-buckets to the same power-of-two
+        # windows `_replay_decode` uses (argmax ignores the key);
+        # sampling walks the RECORDED windows so the split_step chain
+        # replays key for key
+        if strategy == "greedy_search":
+            def plan():
+                j = i
+                while j < len(exp):
+                    n = min(engine.steps_per_sync, len(exp) - j)
+                    while n & (n - 1):
+                        n &= n - 1
+                    yield n, n, jax.random.PRNGKey(0)
+                    j += n
+        else:
+            def plan():
+                for w in capsule.get("windows") or []:
+                    yield w["n_steps"], w["n_toks"], \
+                        _sampling.key_from_fingerprint(w["key"])
+        pad = engine.max_seqs - 1
+        padt = np.zeros((pad,) + engine.cache.page_table.shape[1:],
+                        np.int32)
+        for n_steps, take, key in plan():
+            if i >= len(exp) or take == 0:
+                continue
+            take = min(take, len(exp) - i)
+            if i == 0:
+                # unanchored first token (begin_request capsules): the
+                # live run derived it from the prompt's last logits
+                # inside a 1-step mixed dispatch — re-derive it from
+                # the replay prefill's logits (greedy: same logits ⇒
+                # same argmax; sampling drew at a prefill ROW position
+                # replay cannot reproduce, so it is skipped with a
+                # note and teacher-forced into the KV below)
+                if strategy == "greedy_search":
+                    first = int(np.asarray(jnp.argmax(logits)))
+                    report["steps_compared"] = 1
+                    if first != exp[0]:
+                        _divergence(report, 0, exp[0], first,
+                                    logits if logprobs else None)
+                        st.record_replay(report)
+                        return report
+                else:
+                    report["notes"].append(
+                        "unanchored_sampling_first_token_skipped")
+                i = 1
+                take -= 1
+                if take <= 0:
+                    continue
+            # teacher forcing: every window starts from the last
+            # RECORDED token, so one divergent step cannot cascade
+            feed = exp[i - 1]
+            engine.cache.extend(slot, n_steps)
+            tokens = np.array([feed] + [0] * pad, np.int32)
+            lens = np.concatenate([engine.cache.seq_lens[[slot]],
+                                   np.zeros(pad, np.int32)])
+            tables = np.concatenate(
+                [engine.cache.page_table[[slot]], padt])
+            (toks, engine.cache.k_pages, engine.cache.v_pages,
+             engine.cache.k_scales, engine.cache.v_scales) = \
+                _insp.watched_call(
+                    "engine.decode_step", _eng._paged_decode_step,
+                    engine._stack, engine._norm_w, engine._head_w,
+                    engine._embed_w, engine._rope,
+                    engine.cache.k_pages, engine.cache.v_pages,
+                    engine.cache.k_scales, engine.cache.v_scales,
+                    jnp.asarray(tokens), jnp.asarray(lens, np.int32),
+                    jnp.asarray(tables), jnp.asarray(lens, np.int32),
+                    key, eps=engine.eps, kvh=engine.kvh,
+                    head_dim=engine.head_dim,
+                    transpose_head=engine._tied,
+                    strategy=strategy,
+                    top_k=fp.get("top_k", engine.top_k),
+                    top_p=fp.get("top_p", engine.top_p),
+                    temperature=fp.get("temperature",
+                                       engine.temperature),
+                    n_steps=n_steps)
+            got = np.asarray(jax.device_get(toks))[:, 0]
+            for j in range(take):
+                report["steps_compared"] += 1
+                if int(got[j]) != exp[i + j]:
+                    ctx_logits = None
+                    if logprobs:
+                        ctx_logits = _context_logits(
+                            engine, prompt + exp[:i + j])
+                    _divergence(report, i + j, exp[i + j],
+                                int(got[j]), ctx_logits)
+                    st.record_replay(report)
+                    return report
+            engine.cache.advance([slot], take)
+            i += take
+        if i < len(exp):
+            report["notes"].append(
+                f"window_records_cover_{i}_of_{len(exp)}_tokens")
+        st.record_replay(report)
+        return report
+    finally:
+        engine.cache.release(slot)
+
+
+def _context_logits(engine, context):
+    """Last-token logits over ``prompt + verified tokens`` — one extra
+    chunked prefill in a scratch slot, used only to attach logprob
+    deltas to an already-found divergence."""
+    slot = engine.cache.allocate(len(context) + 1)
+    try:
+        return engine._prefill_seq(slot, context, 0)
+    finally:
+        engine.cache.release(slot)
+
+
+# -- audit ---------------------------------------------------------------------
+def divergence_audit(engine, store=None, n: int = 3,
+                     seed: int = 0) -> dict:
+    """Continuous correctness canary: replay ``n`` deterministically
+    sampled COMPLETE capsules on ``engine`` (typically ANOTHER replica
+    than the one that captured them — cross-replica bit-exactness is
+    the whole point) and record the verdict on the store, where it
+    rides ``metrics_snapshot()`` and federates into
+    ``fleet_snapshot()``."""
+    st = store if store is not None else get_capsule_store()
+    caps = st.sample_complete(n, seed=seed)
+    reports = [replay_capsule(c, engine, store=st) for c in caps]
+    summary = {
+        "t": time.time(), "engine": engine.engine_id,
+        "replayed": len(reports),
+        "bit_exact": sum(1 for r in reports
+                         if r["first_divergence"] is None),
+        "divergent": [
+            {"cap_id": r["cap_id"], "rid": r["rid"],
+             "first_divergence": r["first_divergence"],
+             "expected": r["expected"], "got": r["got"]}
+            for r in reports if r["first_divergence"] is not None],
+        "fingerprint_mismatches": sum(
+            1 for r in reports if r["fingerprint_mismatch"]),
+    }
+    st.record_audit(summary)
+    return summary

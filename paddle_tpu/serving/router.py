@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import capsule as _capsule
 from ..observability import health as _health
 from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
@@ -736,6 +737,16 @@ class ReplicaRouter:
                     int((m.get("checkpoint_staging") or {})
                         .get("dirs") or 0) for m in mems),
             }
+        # capsule-plane federation: capture/replay counters summed
+        # across replicas — a divergent replay ANYWHERE in the fleet
+        # shows up in one row of /fleetz
+        caps = [s.get("capsules") for s in fresh if s.get("capsules")]
+        if caps:
+            fleet["capsules"] = {
+                key: sum(int(c.get(key, 0) or 0) for c in caps)
+                for key in ("captured_total", "persisted_total",
+                            "live", "replays_total",
+                            "divergent_replays_total")}
         out = {"router": self.router_id, "retries": self.retry_count,
                "ejected": sorted(self._ejected),
                "replicas": rows, "fleet": fleet}
@@ -745,4 +756,7 @@ class ReplicaRouter:
         cw = _insp.get_compile_watch()
         if cw.enabled:
             out["introspection"] = cw.snapshot(include_log=False)
+        cs = _capsule.get_capsule_store()
+        if cs.enabled:
+            out["capsules"] = cs.snapshot()
         return out

@@ -69,6 +69,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..common.errors import UnavailableError, enforce
 from ..observability import get_registry
+from ..observability import capsule as _capsule
 from ..observability import health as _health
 from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
@@ -141,6 +142,10 @@ class ScheduledRequest:
         self.spans: Dict[str, object] = {}
         self.timeline: List[tuple] = []
         self.first_token_t: Optional[float] = None
+        # id of this request's capsule once a TRIGGERED capture fired
+        # (slow TTFT / deadline miss / error / sentinel trip) — the
+        # statusz → capsule → replay cross-link
+        self.capsule_id: Optional[str] = None
 
     def __lt__(self, other):                # heapq tie-breaks via seq
         return (self.priority, self.seq) < (other.priority, other.seq)
@@ -187,7 +192,8 @@ class Scheduler:
                  packing: bool = False,
                  packing_max_overtakes: int = 8,
                  chunked_prefill: bool = False,
-                 decode_tpot_slo: Optional[float] = None):
+                 decode_tpot_slo: Optional[float] = None,
+                 slow_ttft: Optional[float] = None):
         enforce(max_queue >= 1, "max_queue must be >= 1")
         enforce(max_preemptions_per_request >= 0,
                 "max_preemptions_per_request must be >= 0")
@@ -208,6 +214,13 @@ class Scheduler:
         self.packing_max_overtakes = packing_max_overtakes
         self.chunked_prefill = bool(chunked_prefill)
         self.decode_tpot_slo = decode_tpot_slo
+        # triggered-capture TTFT threshold (seconds).  None defers to
+        # the CapsuleStore's own ``slow_ttft``; either way a first
+        # token past it persists the request's capsule
+        self.slow_ttft = slow_ttft
+        # sentinel trips already accounted: a NEW trip while requests
+        # are in flight persists their capsules exactly once
+        self._capsule_trips_seen = 0
         self._clock = clock or time.monotonic
         self._lock = threading.RLock()
         self._reqs: Dict[object, ScheduledRequest] = {}
@@ -428,7 +441,17 @@ class Scheduler:
             self._admit(events, out)
             if self.engine.has_work():
                 t0 = time.perf_counter()
-                step_out = self.engine.step()
+                try:
+                    step_out = self.engine.step()
+                except BaseException as e:
+                    # triggered capture: an engine step blowing up is
+                    # THE reproduction case — persist every in-flight
+                    # capsule before the error propagates
+                    for rec in self._reqs.values():
+                        if rec.state == ACTIVE:
+                            self._capsule_persist(
+                                rec, f"error:{type(e).__name__}")
+                    raise
                 self._adapt_prefill_budget(time.perf_counter() - t0,
                                            step_out)
                 for rid, toks in step_out.items():
@@ -442,11 +465,13 @@ class Scheduler:
                         rec.first_token_t = self._clock()
                         rec.timeline.append(("first_token",
                                              rec.first_token_t))
+                        self._capsule_first_token(rec)
                     rec.tokens.extend(toks)
                     out.setdefault(rid, []).extend(toks)
                     self._event(events, rec,
                                 {"type": "tokens", "rid": rid,
                                  "tokens": list(toks)})
+                self._capsule_sentinel_check()
             self._retire_done(events)
         self._dispatch(events)
         return out
@@ -589,6 +614,7 @@ class Scheduler:
                 "n_tokens": len(rec.tokens),
                 "deadline_missed": rec.deadline_missed,
                 "shed_reason": rec.shed_reason,
+                "capsule": rec.capsule_id,
                 "timeline": [{"event": e, "t": t}
                              for e, t in rec.timeline],
             }
@@ -603,7 +629,8 @@ class Scheduler:
                      "age": now - rec.submit_t,
                      "n_tokens": len(rec.tokens),
                      "preemptions": rec.preempts,
-                     "trace_id": (rec.trace_ctx or {}).get("trace_id")}
+                     "trace_id": (rec.trace_ctx or {}).get("trace_id"),
+                     "capsule": rec.capsule_id}
                     for rec in self._reqs.values()
                     if rec.state in (WAITING, ACTIVE, SUSPENDED)]
 
@@ -639,6 +666,15 @@ class Scheduler:
                            else rec.deadline - now,
                        "trace": rec.trace_ctx,
                        "on_event": rec.on_event}
+                # sync lifecycle context into the capsule BEFORE the
+                # engine exports it into the package — the capsule
+                # travels whole (timeline, windows, key anchor) and
+                # replays on the destination
+                cs = _capsule.get_capsule_store()
+                if cs.enabled:
+                    cs.annotate(rid, timeline=list(rec.timeline),
+                                trace_id=(rec.trace_ctx or {}).get(
+                                    "trace_id"))
                 ereq = self.engine.requests.get(rid)
                 if rec.state == WAITING:
                     pkg.update({
@@ -683,6 +719,14 @@ class Scheduler:
                         "max_new": epkg["max_new"], "eos": epkg["eos"],
                         "swap": epkg["swap"],
                         "max_queue_time_remaining": None})
+                # the capsule rides the package: admitted exports get
+                # it from the engine package, policy-only paths lift
+                # it straight out of the store (plain JSON — remote
+                # transports ship it untouched)
+                if pkg.get("capsule") is None:
+                    pkg["capsule"] = epkg.get("capsule") \
+                        if pkg["admitted"] else \
+                        (cs.export(rid) if cs.enabled else None)
                 rec.state = MIGRATED
                 self._trace_terminal(rec, MIGRATED)
                 del self._reqs[rid]
@@ -726,12 +770,20 @@ class Scheduler:
                 self.engine.import_request(
                     {"rid": rid, "prompt": pkg["prompt"],
                      "out": pkg["tokens"], "max_new": pkg["max_new"],
-                     "eos": pkg["eos"], "swap": pkg.get("swap")})
+                     "eos": pkg["eos"], "swap": pkg.get("swap"),
+                     "capsule": pkg.get("capsule")})
                 rec.tokens = list(pkg["tokens"])
                 rec.state = SUSPENDED
                 rec.preempt_t = now
                 self._n_suspended += 1
             else:
+                # policy-only package: the engine never sees it here —
+                # adopt its capsule directly (a fresh admission will
+                # open a new capture; until then the source's history
+                # stays queryable)
+                cs = _capsule.get_capsule_store()
+                if cs.enabled and pkg.get("capsule"):
+                    cs.adopt(pkg["capsule"])
                 if self._n_waiting >= self.max_queue:
                     self._shed_inc("queue_full")
                     raise RejectedError(
@@ -852,6 +904,11 @@ class Scheduler:
         if cw.enabled:
             snap["introspection"] = cw.snapshot(include_log=False)
             snap["memory"] = _insp.memory_brief()
+        # request-capsule plane rides along too — capture counters +
+        # audit verdicts, summed across replicas by fleet_snapshot()
+        cs = _capsule.get_capsule_store()
+        if cs.enabled:
+            snap["capsules"] = cs.snapshot()
         return snap
 
     # -- internals (lock held) -------------------------------------------------
@@ -863,6 +920,57 @@ class Scheduler:
     def _dispatch(events):
         for cb, ev in events:
             cb(ev)
+
+    # -- capsule internals (lock held; strict no-ops with capture off) ---------
+    def _capsule_persist(self, rec, reason: str):
+        """Triggered capture: sync the lifecycle timeline + trace_id
+        into the request's capsule, persist it with ``reason``, and
+        cross-link the capsule id onto the record and the flight
+        recorder (so /statusz and the slow-request WARNING can point
+        straight at it)."""
+        cs = _capsule.get_capsule_store()
+        if not cs.enabled:
+            return None
+        trace_id = (rec.trace_ctx or {}).get("trace_id")
+        cs.annotate(rec.rid, timeline=list(rec.timeline),
+                    trace_id=trace_id)
+        cap_id = cs.persist(rec.rid, reason)
+        if cap_id is not None:
+            rec.capsule_id = cap_id
+            _tracing.record_event(
+                "capsule_captured", rid=str(rec.rid), capsule=cap_id,
+                reason=reason, trace_id=trace_id, sched=self.sched_id)
+        return cap_id
+
+    def _capsule_first_token(self, rec):
+        """Slow-TTFT trigger, called where ``first_token_t`` is
+        stamped (sync admission and the chunked-delivery merge
+        loop)."""
+        cs = _capsule.get_capsule_store()
+        if not cs.enabled or rec.first_token_t is None:
+            return
+        thr = self.slow_ttft if self.slow_ttft is not None \
+            else cs.slow_ttft
+        if thr is not None and \
+                rec.first_token_t - rec.submit_t > thr:
+            self._capsule_persist(rec, "slow_ttft")
+
+    def _capsule_sentinel_check(self):
+        """Persist in-flight capsules when the AnomalySentinel tripped
+        since the last check — the trip and the requests decoding
+        through it are the reproduction case."""
+        cs = _capsule.get_capsule_store()
+        if not cs.enabled:
+            return
+        sent = getattr(_health.get_health(), "sentinel", None)
+        if sent is None:
+            return
+        trips = len(sent.trips)
+        if trips > self._capsule_trips_seen:
+            self._capsule_trips_seen = trips
+            for rec in self._reqs.values():
+                if rec.state == ACTIVE:
+                    self._capsule_persist(rec, "sentinel_trip")
 
     # -- tracing internals (lock held; strict no-ops with tracing off) ---------
     def _trace_enqueue(self, rec, trace_ctx, suspended: bool = False):
@@ -950,6 +1058,11 @@ class Scheduler:
             rec.shed_reason = reason
             rec.finish_t = now
             self._n_waiting -= 1
+            if reason == "deadline":
+                # waiting requests were never admitted, so this is
+                # usually a no-op — it fires for requests admitted
+                # then re-queued (preemptees) whose deadline lapsed
+                self._capsule_persist(rec, "deadline_miss")
             self._trace_terminal(rec, SHED, reason=reason)
             self._shed_inc(reason)
             self._event(events, rec, {"type": "shed", "rid": rec.rid,
@@ -1041,6 +1154,7 @@ class Scheduler:
             return
         rec.first_token_t = self._clock()   # admission's prefill token
         rec.timeline.append(("first_token", rec.first_token_t))
+        self._capsule_first_token(rec)
         first = list(eng.requests[rec.rid].out)
         rec.tokens.extend(first)
         out.setdefault(rec.rid, []).extend(first)
@@ -1127,6 +1241,14 @@ class Scheduler:
                 rec.deadline_missed = True
                 if self._metrics is not None:
                     self._metrics["deadline_miss"].inc()
+                self._capsule_persist(rec, "deadline_miss")
+            # retirement closes the capsule: final timeline + trace
+            # cross-link, marked COMPLETE (audit-eligible)
+            cs = _capsule.get_capsule_store()
+            if cs.enabled:
+                cs.annotate(rid, timeline=list(rec.timeline),
+                            trace_id=(rec.trace_ctx or {}).get(
+                                "trace_id"), complete=True)
             if self._metrics is not None:
                 self._metrics["completed"].inc()
             _health.get_health().event("error_rate", bad=False)

@@ -14,7 +14,11 @@ Data-plane endpoints (end users):
   ``{"id", "tokens": [...]}`` line per engine step window as tokens
   are produced, then a terminal ``{"id", "done": true, "state",
   "n_tokens", "deadline_missed"}`` line.  ``"stream": false``
-  returns one JSON object with the full token list.  Overload maps to
+  returns one JSON object with the full token list.  A streaming
+  client that sends ``Accept: text/event-stream`` gets the SAME
+  events as SSE instead: ``data: {json}`` frames (one per NDJSON
+  line, produced by one shared encoder) closed by a ``data: [DONE]``
+  terminator.  Overload maps to
   HTTP: a shed request is ``429``, an invalid one ``400``, an
   oversized body ``413``.  Unless the body names its own
   ``deadline``, the frontend's ``request_timeout`` is submitted as
@@ -47,6 +51,11 @@ connections):
   when the scheduler is DRAINING or the loop thread died (WEDGED) —
   the prober and any LB act on the status code alone.
 * ``GET /metrics`` — Prometheus text via the observability registry.
+* ``GET /capsulez`` / ``GET /v1/capsule?rid=`` /
+  ``POST /v1/replay`` — the request-capsule plane: store summary,
+  one full capsule, and bit-exact replay of a capsule (local by rid
+  or shipped in the body) through this backend's engine, returning
+  the per-step divergence report.
 
 The frontend owns the scheduling loop: a daemon thread drives
 ``target.step()`` whenever work is pending, so handler threads only
@@ -72,6 +81,7 @@ from typing import Optional
 
 from ..common.errors import EnforceError, UnavailableError
 from ..observability import get_registry
+from ..observability import capsule as _capsule
 from ..observability import health as _health
 from ..observability import introspection as _insp
 from ..observability import tracing as _tracing
@@ -193,6 +203,11 @@ class HTTPFrontend:
                     frontend._guarded(self, frontend._compilez)
                 elif path == "/memz":
                     frontend._guarded(self, frontend._memz)
+                elif path == "/capsulez":
+                    frontend._guarded(self, frontend._capsulez)
+                elif path == "/v1/capsule":
+                    frontend._guarded(
+                        self, lambda: frontend._capsule_get(query))
                 else:
                     self._json(404, {"error": f"no route {path}"})
 
@@ -210,6 +225,7 @@ class HTTPFrontend:
                     "/v1/timeline": frontend._cp_timeline,
                     "/v1/migrate_out": frontend._cp_migrate_out,
                     "/v1/migrate_in": frontend._cp_migrate_in,
+                    "/v1/replay": frontend._cp_replay,
                 }
                 fn = routes.get(path)
                 if fn is None:
@@ -413,6 +429,19 @@ class HTTPFrontend:
         rec = _tracing.get_flight_recorder()
         out["recent_errors"] = rec.recent_errors() \
             if rec is not None else []
+        cs = _capsule.get_capsule_store()
+        if cs.enabled:
+            out["capsules"] = cs.snapshot()
+            # an error line with a captured capsule carries its id —
+            # the operator goes straight from /statusz to
+            # /v1/capsule?rid= to /v1/replay without grepping logs
+            annotated = []
+            for err in out["recent_errors"]:
+                rid = err.get("rid")
+                cap = cs.capsule_id(rid) if rid is not None else None
+                annotated.append({**err, "capsule": cap}
+                                 if cap is not None else err)
+            out["recent_errors"] = annotated
         return out
 
     @staticmethod
@@ -504,6 +533,28 @@ class HTTPFrontend:
         return {"enabled": True, "threshold_ms": thr_ms,
                 "traces": traces}
 
+    # -- handlers: capsules ----------------------------------------------------
+    def _capsulez(self) -> dict:
+        """Capture/replay plane summary: store counters, recent
+        audits, and one brief row per live capsule
+        (``{"enabled": false}`` when the plane is off — the endpoint
+        always answers, like /compilez)."""
+        return _capsule.get_capsule_store().capsulez()
+
+    def _capsule_get(self, query: str) -> dict:
+        """The full capsule for one request id — what an operator
+        downloads to replay elsewhere (``POST /v1/replay`` accepts it
+        verbatim as ``{"capsule": ...}``)."""
+        qs = urllib.parse.parse_qs(query or "")
+        rid = (qs.get("rid") or [None])[0]
+        if not rid:
+            raise EnforceError("need ?rid=<request id>")
+        cap = _capsule.get_capsule_store().get(rid)
+        if cap is None:
+            raise EnforceError(f"no capsule for rid {rid!r} (capture "
+                               f"off, never captured, or evicted)")
+        return {"id": rid, "capsule": cap}
+
     # -- handlers: data plane --------------------------------------------------
     def _completions(self, handler, body: dict):
         prompt = body.get("prompt")
@@ -552,7 +603,12 @@ class HTTPFrontend:
             return
         try:
             if stream:
-                self._stream_response(handler, rid, events)
+                # an Accept: text/event-stream client gets SSE
+                # framing; everything else keeps the chunked-NDJSON
+                # default.  Same events, same teardown.
+                sse = "text/event-stream" in \
+                    (handler.headers.get("Accept") or "")
+                self._stream_response(handler, rid, events, sse=sse)
             else:
                 self._unary_response(handler, rid, events)
         finally:
@@ -574,10 +630,17 @@ class HTTPFrontend:
         if ttft is None or ttft <= self.slow_ttft:
             return
         trace_id = tl.get("trace_id") or root.trace_id
+        cap_id = tl.get("capsule")
+        cs = _capsule.get_capsule_store()
+        if cs.enabled and cap_id is None:
+            # router-fronted targets may not have the scheduler-side
+            # threshold armed — persist here so the slow line always
+            # lands a replayable capsule handle
+            cap_id = cs.persist(rid, "slow_ttft")
         _LOG.warning(
-            "slow request rid=%s trace_id=%s ttft=%.3fs "
+            "slow request rid=%s trace_id=%s capsule=%s ttft=%.3fs "
             "queue_wait=%s preemptions=%s state=%s n_tokens=%s",
-            rid, trace_id, ttft,
+            rid, trace_id, cap_id, ttft,
             f"{tl['queue_wait']:.3f}s"
             if tl.get("queue_wait") is not None else "?",
             tl.get("preemptions"), tl.get("state"),
@@ -608,36 +671,64 @@ class HTTPFrontend:
         except queue.Empty:
             return None
 
-    def _stream_response(self, handler, rid, events):
+    @staticmethod
+    def _encode_stream_event(rid, ev, n_tokens):
+        """One queued engine event → its wire object — the SINGLE
+        encoding both stream framings (NDJSON lines and SSE ``data:``
+        events) share, so the two streams cannot drift.  Returns
+        ``(obj_or_None, n_tokens, done)``; ``ev is None`` means the
+        event wait timed out."""
+        if ev is None:
+            return ({"id": rid, "done": True, "state": "timeout",
+                     "n_tokens": n_tokens}, n_tokens, True)
+        if ev["type"] == "tokens":
+            n_tokens += len(ev["tokens"])
+            return ({"id": rid, "tokens": ev["tokens"]},
+                    n_tokens, False)
+        if ev["type"] in _TERMINAL:
+            return ({"id": rid, "done": True, "state": ev["type"],
+                     "n_tokens": len(ev.get("tokens", [])) or
+                     n_tokens,
+                     "deadline_missed": ev.get("deadline_missed",
+                                               False),
+                     "reason": ev.get("reason")}, n_tokens, True)
+        return None, n_tokens, False
+
+    def _stream_response(self, handler, rid, events,
+                         sse: bool = False):
         handler.send_response(200)
-        handler.send_header("Content-Type", "application/x-ndjson")
+        handler.send_header("Content-Type",
+                            "text/event-stream" if sse
+                            else "application/x-ndjson")
         handler.send_header("Transfer-Encoding", "chunked")
+        if sse:
+            handler.send_header("Cache-Control", "no-cache")
         handler.end_headers()
 
-        def chunk(obj: dict):
-            data = (json.dumps(obj) + "\n").encode("utf-8")
+        def chunk(data: bytes):
             handler.wfile.write(hex(len(data))[2:].encode("ascii") +
                                 b"\r\n" + data + b"\r\n")
             handler.wfile.flush()
 
+        def emit(obj: dict):
+            if sse:
+                chunk(b"data: " +
+                      json.dumps(obj).encode("utf-8") + b"\n\n")
+            else:
+                chunk((json.dumps(obj) + "\n").encode("utf-8"))
+
         n_tokens = 0
         while True:
             ev = self._next_event(events)
-            if ev is None:
-                chunk({"id": rid, "done": True, "state": "timeout",
-                       "n_tokens": n_tokens})
+            obj, n_tokens, done = self._encode_stream_event(
+                rid, ev, n_tokens)
+            if obj is not None:
+                emit(obj)
+            if done:
                 break
-            if ev["type"] == "tokens":
-                n_tokens += len(ev["tokens"])
-                chunk({"id": rid, "tokens": ev["tokens"]})
-            elif ev["type"] in _TERMINAL:
-                chunk({"id": rid, "done": True, "state": ev["type"],
-                       "n_tokens": len(ev.get("tokens", [])) or
-                       n_tokens,
-                       "deadline_missed": ev.get("deadline_missed",
-                                                 False),
-                       "reason": ev.get("reason")})
-                break
+        if sse:
+            chunk(b"data: [DONE]\n\n")   # the SSE terminator clients
+                                         # key end-of-stream on
         handler.wfile.write(b"0\r\n\r\n")
         handler.wfile.flush()
 
@@ -806,6 +897,36 @@ class HTTPFrontend:
             return {"id": pkg["rid"], "accepted": True}
 
         self._guarded(handler, migrate)
+
+    def _cp_replay(self, handler, body: dict):
+        """Replay a capsule through THIS backend's engine and return
+        the per-step divergence report.  Body: ``{"id": rid}``
+        (resolved from the local store) or ``{"capsule": {...}}`` (a
+        capsule fetched from another replica — the cross-replica audit
+        hop).  Replay is engine work, so it runs on the loop thread
+        like migration."""
+        def replay():
+            cap = body.get("capsule")
+            if cap is None and body.get("id") is not None:
+                cap = _capsule.get_capsule_store().get(body["id"])
+                if cap is None:
+                    raise EnforceError(
+                        f"no capsule for rid {body['id']!r}")
+            if not isinstance(cap, dict):
+                raise EnforceError(
+                    "need 'capsule' (a capsule object) or 'id' (a rid "
+                    "with a live capsule)")
+            engine = getattr(self.target, "engine", None)
+            if engine is None:
+                raise EnforceError(
+                    "replay needs a scheduler-fronted backend (the "
+                    "router tier has no engine of its own — POST to a "
+                    "replica)")
+            return self._on_loop(
+                lambda: _capsule.replay_capsule(cap, engine),
+                timeout=300.0)
+
+        self._guarded(handler, replay)
 
 
 def start_http_frontend(target, addr: str = "127.0.0.1",

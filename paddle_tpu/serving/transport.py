@@ -25,7 +25,12 @@ the common case:
   deltas, so a dropped poll loses nothing (the next poll re-diffs);
 * a structured ``FaultPlan`` (serving/faults.py) can be installed at
   this seam — every injected refuse/timeout/slow/disconnect/crash
-  exercises exactly the retry/idempotency machinery above.
+  exercises exactly the retry/idempotency machinery above;
+* migration packages are JSON end to end: only the ``swap`` blob
+  needs base64 framing — the request CAPSULE
+  (observability/capsule.py) the package may carry is already plain
+  JSON and ships untouched, so a drained request stays bit-exactly
+  replayable on the destination host.
 
 ``HealthProber`` actively polls each replica's ``health()`` and feeds
 the router's circuit breaker, distinguishing SLOW from DEAD:

@@ -74,6 +74,18 @@ def _reset_compile_watch():
 
 
 @pytest.fixture(autouse=True)
+def _reset_capsule_store():
+    """Disable the process-global CapsuleStore after every test — the
+    same process-global hygiene as ``_reset_compile_watch``: every
+    engine admission consults the live store, so one test's enabled
+    capture would otherwise record the next test's requests and its
+    counter/identity assertions become order-dependent."""
+    yield
+    from paddle_tpu.observability import capsule as _cap
+    _cap.disable_capsule_capture()
+
+
+@pytest.fixture(autouse=True)
 def _decode_window_zero_recompiles(request):
     """Scanned-window tests (the ``decode_window`` suite) must leave
     ZERO ``jit_recompile_events_total`` on the warm engine: the
