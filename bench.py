@@ -2238,6 +2238,103 @@ def verify_dropout_smoke():
                       "mean_err": round(mean_err, 4)}}
 
 
+def bench_serving_tp():
+    """Sharded-serving row (ISSUE 18): the same staggered greedy
+    workload through a tp=1 engine and a tp=2 tensor-parallel engine
+    over a GSPMD mesh (forced-host CPU devices off-TPU, real chips on).
+    The sharding discipline constrains only OUTPUT axes and gathers
+    every contraction input first, so the row asserts tokens are
+    BIT-IDENTICAL across tp — sharding is a pure capacity/latency
+    lever, never a numerics knob.  Also measured: the one-compile
+    invariant per mesh shape (a second tp=2 engine must add zero
+    mixed/window compiles) and the per-chip KV-pool bytes from
+    ``memory_rows()``.  Headline: the per-chip KV capacity multiplier
+    of tp=2 + int8 KV over the tp=1 fp32 pool — the two levers
+    (head-sharding the pools, per-token int8) multiply instead of
+    fighting, which is the point of keeping the scale pools on the
+    same KVH sharding."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.topology import serving_mesh
+    from paddle_tpu.inference.engine import LLMEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    _, kind, peak, hbm, on_tpu = _device()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=1536,
+                          intermediate_size=6144, num_hidden_layers=16,
+                          num_attention_heads=12, num_key_value_heads=4,
+                          max_position_embeddings=2048)
+        batch, new, page, maxlen, sync = 8, 128, 128, 2048, 16
+        prompts = [96, 57, 128, 101, 77, 120, 64, 115]
+        dtype = jnp_bf16()
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config()
+        batch, new, page, maxlen, sync = 4, 48, 8, 128, 4
+        prompts = [8, 5, 12, 9]
+        dtype = np.float32
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"metric": "llama_serving_tp_kv_per_chip_multiplier",
+                "unit": "x", "value": 1.0,
+                "extra": {"device_kind": kind, "note":
+                          "single device — no tp mesh (run tests "
+                          "under the forced 8-device CPU platform)"}}
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def run(mesh, **kw):
+        rng = np.random.default_rng(0)
+        eng = LLMEngine(model, max_seqs=batch, max_len=maxlen,
+                        page_size=page, dtype=dtype,
+                        steps_per_sync=sync, unified_step=True,
+                        mesh=mesh, **kw)
+        for i, plen in enumerate(prompts):
+            eng.add_request(
+                f"t{i}", rng.integers(1, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=new)
+            eng.step()                 # staggered: batches churn
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        toks = {f"t{i}": eng.result(f"t{i}")
+                for i in range(len(prompts))}
+        produced = sum(len(v) for v in toks.values())
+        return eng, toks, produced / dt
+
+    mesh2 = serving_mesh(2)
+    eng1, want, rate1 = run(None)
+    eng2, got, rate2 = run(mesh2)
+    bit_identical = got == want
+    base_m = LLMEngine.mixed_compiles()
+    base_w = LLMEngine.window_compiles()
+    run(mesh2)                         # second tp=2 engine, same mesh
+    mixed_delta = LLMEngine.mixed_compiles() - base_m
+    window_delta = LLMEngine.window_compiles() - base_w
+
+    rows1 = eng1.cache.memory_rows()             # tp=1 fp32 pool
+    eng_i8, _, _ = run(mesh2, kv_dtype="int8")
+    rows_i8 = eng_i8.cache.memory_rows()         # tp=2 int8 + scales
+    per_chip_fp1 = rows1["device_bytes_per_shard"]
+    per_chip_i8tp2 = rows_i8["device_bytes_per_shard"]
+    mult = per_chip_fp1 / max(per_chip_i8tp2, 1)
+    return {"metric": "llama_serving_tp_kv_per_chip_multiplier",
+            "unit": "x", "value": round(mult, 2),
+            "extra": {"device_kind": kind, "tp": 2,
+                      "bit_identical_tp1_vs_tp2": bit_identical,
+                      "mixed_compile_delta_same_mesh": mixed_delta,
+                      "window_compile_delta_same_mesh": window_delta,
+                      "tokens_per_sec_tp1": round(rate1, 1),
+                      "tokens_per_sec_tp2": round(rate2, 1),
+                      "kv_bytes_per_chip_tp1_fp32": per_chip_fp1,
+                      "kv_bytes_per_chip_tp2_int8": per_chip_i8tp2,
+                      "budget": "bit_identical AND zero compile "
+                                "delta on a warm mesh shape"}}
+
+
 def bench_history(root=None, emit=True):
     """Fold every ``BENCH_rNN.json`` snapshot (the driver's one-file-
     per-round bench record) into ONE trajectory table: a row per
@@ -2339,7 +2436,8 @@ def main():
                ("bench_engine_window", bench_engine_window),
                ("bench_decode_window", bench_decode_window),
                ("bench_longseq", bench_longseq),
-               ("bench_capsule", bench_capsule)]
+               ("bench_capsule", bench_capsule),
+               ("bench_serving_tp", bench_serving_tp)]
         failed = 0
         for fname, fn in fns:
             try:

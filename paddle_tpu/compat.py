@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["axis_size", "shard_map"]
+__all__ = ["axis_size", "shard_map", "named_sharding",
+           "with_sharding_constraint"]
 
 
 def axis_size(name):
@@ -23,6 +24,30 @@ def axis_size(name):
     if hasattr(lax, "axis_size"):
         return lax.axis_size(name)
     return lax.psum(1, name)
+
+
+def named_sharding(mesh, *spec):
+    """``NamedSharding(mesh, PartitionSpec(*spec))`` — one import site
+    for the ``jax.sharding`` spelling (0.4.x) with the ancestral
+    ``jax.experimental`` fallback kept for very old interpreters."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+    except ImportError:                                  # pragma: no cover
+        from jax.experimental.sharding import NamedSharding
+        from jax.experimental import PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def with_sharding_constraint(x, sharding):
+    """``jax.lax.with_sharding_constraint`` when available (0.4.x+),
+    else the ``jax.experimental.pjit`` spelling.  Accepts any Sharding
+    (build one with ``named_sharding``)."""
+    from jax import lax
+    if hasattr(lax, "with_sharding_constraint"):
+        return lax.with_sharding_constraint(x, sharding)
+    from jax.experimental.pjit import (                  # pragma: no cover
+        with_sharding_constraint as _wsc)
+    return _wsc(x, sharding)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,

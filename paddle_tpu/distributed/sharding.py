@@ -23,7 +23,59 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["ShardingPlan", "plan_param_spec", "group_sharded_parallel"]
+__all__ = ["ShardingPlan", "plan_param_spec", "group_sharded_parallel",
+           "TPShardings"]
+
+
+class TPShardings:
+    """Hashable tensor-parallel sharding plan for the serving engine.
+
+    Carried as a STATIC jit argument by the serving programs
+    (engine.py): one distinct ``TPShardings`` per mesh shape hashes to
+    one trace, so the one-compile-per-program invariant becomes
+    one-compile-per-mesh-shape.  ``Mesh`` itself is hashable, which is
+    what makes this safe to put in ``static_argnames``.
+
+    ``constrain(x, dim)`` applies ``with_sharding_constraint`` with the
+    tp axis on ``dim`` (``None`` = fully replicated); ``put(x, dim)``
+    commits a host array the same way at init time.
+    """
+
+    __slots__ = ("mesh", "axis")
+
+    def __init__(self, mesh: Mesh, axis: str = "tp"):
+        self.mesh = mesh
+        self.axis = axis
+
+    @property
+    def tp(self) -> int:
+        return _axis_size(self.mesh, self.axis)
+
+    def _sharding(self, ndim: int, dim: Optional[int]):
+        from .. import compat
+        spec = [None] * ndim
+        if dim is not None:
+            spec[dim] = self.axis
+        return compat.named_sharding(self.mesh, *spec)
+
+    def constrain(self, x, dim: Optional[int] = None):
+        from .. import compat
+        return compat.with_sharding_constraint(
+            x, self._sharding(x.ndim, dim))
+
+    def put(self, x, dim: Optional[int] = None):
+        x = jax.numpy.asarray(x)
+        return jax.device_put(x, self._sharding(x.ndim, dim))
+
+    def __hash__(self):
+        return hash((self.mesh, self.axis))
+
+    def __eq__(self, other):
+        return (isinstance(other, TPShardings)
+                and self.mesh == other.mesh and self.axis == other.axis)
+
+    def __repr__(self):
+        return f"TPShardings(tp={self.tp}, axis={self.axis!r})"
 
 
 def _axis_size(mesh: Mesh, name: str) -> int:

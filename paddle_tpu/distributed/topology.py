@@ -23,9 +23,24 @@ from jax.sharding import Mesh
 from ..common.errors import enforce
 from .strategy import HybridConfig
 
-__all__ = ["HybridCommunicateGroup", "CommGroup", "build_mesh"]
+__all__ = ["HybridCommunicateGroup", "CommGroup", "build_mesh",
+           "serving_mesh"]
 
 AXES = ("pp", "dp", "sharding", "ep", "sep", "mp")
+
+
+def serving_mesh(tp: int, axis: str = "tp",
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh for tensor-parallel serving (`LLMEngine(mesh=...)`).
+
+    Takes the first ``tp`` devices — on CPU these are the virtual
+    devices created by ``--xla_force_host_platform_device_count``, on
+    TPU a single ICI-adjacent prefix of the default device order."""
+    devices = list(devices if devices is not None else jax.devices())
+    enforce(tp >= 1, f"tp degree must be >= 1, got {tp}")
+    enforce(tp <= len(devices),
+            f"serving mesh tp={tp} needs {tp} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:tp]), (axis,))
 
 
 def build_mesh(hybrid: HybridConfig, devices: Optional[Sequence] = None

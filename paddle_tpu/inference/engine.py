@@ -149,16 +149,37 @@ def _mm(x, w):
     return jnp.matmul(x, w)
 
 
+def _tpc(x, shardings, dim=None):
+    """Tensor-parallel sharding constraint: shard ``dim`` over the tp
+    axis (``None`` = fully replicated) when the engine carries a mesh,
+    identity otherwise — so the no-mesh trace is byte-identical to the
+    pre-sharding programs.
+
+    The placement discipline that keeps tp=N BIT-IDENTICAL to tp=1 on
+    greedy: only OUTPUT axes are ever sharded (head axes, MLP hidden,
+    the o/down projections' H outputs), and every contraction input is
+    constrained REPLICATED first.  A contraction over a sharded axis
+    would lower to partial-sum + psum — a cross-device float reduction
+    whose order differs from the single-device dot — while gathering
+    the inputs (all-gather moves bits, never adds floats) keeps every
+    matmul's reduction on one device in one order."""
+    if shardings is None:
+        return x
+    return shardings.constrain(x, dim)
+
+
 @functools.partial(
     __import__("jax").jit,
-    static_argnames=("eps", "kvh", "head_dim", "transpose_head"),
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "shardings"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
                          k_pages, v_pages, k_scales, v_scales,
                          ids, table, prev_len,
                          page_slot, last_in_chunk, *, eps: float,
                          kvh: int, head_dim: int,
-                         transpose_head: bool = False):
+                         transpose_head: bool = False,
+                         shardings=None):
     """CHUNKED ragged prefill (round 5): process ``ids`` [C] — one
     page-sized chunk of ONE prompt — against the paged cache.  Each
     chunk's K/V fill exactly one page (C == page_size), written with a
@@ -241,9 +262,9 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
         iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
         hn = _nn.rms_norm(hcur, iln, epsilon=eps)
         nh = _wout(qw) // head_dim
-        q = _mm(hn, qw).reshape(c, nh, head_dim)
-        k = _mm(hn, kw).reshape(c, kvh, head_dim)
-        v = _mm(hn, vw).reshape(c, kvh, head_dim)
+        q = _tpc(_mm(hn, qw).reshape(c, nh, head_dim), shardings, 1)
+        k = _tpc(_mm(hn, kw).reshape(c, kvh, head_dim), shardings, 1)
+        v = _tpc(_mm(hn, vw).reshape(c, kvh, head_dim), shardings, 1)
         qf, kf = q.astype(jnp.float32)[None], k.astype(jnp.float32)[None]
         q = (qf * cos + rotate_half(qf) * sin)[0].astype(q.dtype)
         k = (kf * cos + rotate_half(kf) * sin)[0].astype(k.dtype)
@@ -281,30 +302,43 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
             v_full = (vp[:, table].astype(jnp.float32)
                       * vsp[:, table][..., None]).reshape(kvh, s_kv,
                                                           head_dim)
-        attn = attend(q, jnp.swapaxes(k_full, 0, 1),
-                      jnp.swapaxes(v_full, 0, 1))
-        hcur = hcur + _mm(attn.reshape(c, nh * head_dim), ow)
+        attn = _tpc(attend(q, jnp.swapaxes(k_full, 0, 1),
+                           jnp.swapaxes(v_full, 0, 1)), shardings, 1)
+        # gather the head-sharded attention rows BEFORE the o_proj
+        # contraction, and the hidden-sharded ff before down_proj —
+        # the bit-exactness discipline (see _tpc)
+        hcur = _tpc(hcur + _mm(_tpc(attn.reshape(c, nh * head_dim),
+                                    shardings), ow), shardings)
         hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
-        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
+        ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
+        return (_tpc(hcur + _mm(_tpc(ff, shardings), dw), shardings),
+                (kp, vp, ksp, vsp))
 
     x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
         layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
     xl = jnp.take(x, last_in_chunk, axis=0)   # [H]
-    logits = jnp.matmul(xl, head_w.T) if transpose_head \
-        else _mm(xl, head_w)
+    logits = _tpc(jnp.matmul(xl, head_w.T) if transpose_head
+                  else _mm(xl, head_w), shardings)
     return logits, k_pages, v_pages, k_scales, v_scales
 
 
 def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                          *, eps, kvh, head_dim, transpose_head,
-                         strategy, top_k, top_p, temperature):
+                         strategy, top_k, top_p, temperature,
+                         draw_base=None, shardings=None):
     """Build the one-token decode body shared by ``_paged_decode_step``
     (fixed-length window) and ``_paged_decode_window`` (the early-exit
     scanned window).  ONE definition of the per-step math — embed,
     rope, fused append+attend, sample, ``split_step`` key chain — is
     what makes the two programs bit-identical step for step.
+
+    ``draw_base`` (traced int32 scalar) offsets the per-row sampling
+    fold: row i draws with ``fold_row(sub, draw_base + i)`` — the live
+    engine always passes 0 (row i folds i), capsule replay passes the
+    CAPTURED row so a request replayed in row 0 re-draws its original
+    stream (see inference/sampling.py).  Unused by greedy.
+    ``shardings`` threads the tensor-parallel constraints (see _tpc).
 
     carry: (tokens [B], positions [B], lens [B], k_pages, v_pages,
     k_scales, v_scales, key) → the same tuple one step later, with the
@@ -345,9 +379,9 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
             iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
             hn = _nn.rms_norm(hcur, iln, epsilon=eps)
             nh = _wout(qw) // head_dim
-            q = _mm(hn, qw).reshape(b, nh, head_dim)
-            k = _mm(hn, kw).reshape(b, kvh, head_dim)
-            v = _mm(hn, vw).reshape(b, kvh, head_dim)
+            q = _tpc(_mm(hn, qw).reshape(b, nh, head_dim), shardings, 1)
+            k = _tpc(_mm(hn, kw).reshape(b, kvh, head_dim), shardings, 1)
+            v = _tpc(_mm(hn, vw).reshape(b, kvh, head_dim), shardings, 1)
             qf = q.astype(jnp.float32)
             kf = k.astype(jnp.float32)
             q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
@@ -363,21 +397,28 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                     ksp[:, :, None, :], vsp[:, :, None, :])
                 ksp = ks4.reshape(ksp.shape)
                 vsp = vs4.reshape(vsp.shape)
-            hcur = hcur + _mm(attn.reshape(b, nh * head_dim), ow)
+            attn = _tpc(attn, shardings, 1)
+            hcur = _tpc(hcur + _mm(
+                _tpc(attn.reshape(b, nh * head_dim), shardings), ow),
+                shardings)
             hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-            ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
-            return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
+            ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
+            return (_tpc(hcur + _mm(_tpc(ff, shardings), dw),
+                         shardings), (kp, vp, ksp, vsp))
 
         x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
             layer, x, (tuple(stack), k_pages, v_pages, k_scales,
                        v_scales))
         x = _nn.rms_norm(x, norm_w, epsilon=eps)
-        logits = jnp.matmul(x, head_w.T) if transpose_head \
-            else _mm(x, head_w)
+        logits = _tpc(jnp.matmul(x, head_w.T) if transpose_head
+                      else _mm(x, head_w), shardings)
         key, sub = split_step(key)
+        row_ids = None if strategy == "greedy_search" else \
+            draw_base + jnp.arange(b, dtype=jnp.int32)
         nxt, _ = sample_logits(logits, sub, strategy=strategy,
                                top_k=top_k, top_p=top_p,
-                               temperature=temperature)
+                               temperature=temperature,
+                               row_ids=row_ids)
         return (nxt, positions + 1, lens + 1, k_pages, v_pages,
                 k_scales, v_scales, key)
 
@@ -388,16 +429,17 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps"),
+                     "n_steps", "shardings"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                        k_pages, v_pages, k_scales, v_scales,
                        tokens, positions, tables, lens,
-                       key, *, eps: float, kvh: int, head_dim: int,
+                       key, draw_base=0, *, eps: float, kvh: int,
+                       head_dim: int,
                        transpose_head: bool = False,
                        strategy: str = "greedy_search", top_k: int = 0,
                        top_p: float = 1.0, temperature: float = 1.0,
-                       n_steps: int = 1):
+                       n_steps: int = 1, shardings=None):
     """``n_steps`` decode tokens for every active sequence as ONE XLA
     program (multi-step scheduling: the host syncs — EOS checks,
     admission — every n_steps tokens, so dispatch latency amortizes
@@ -418,7 +460,8 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
         stack, norm_w, head_w, embed_w, rope, tables,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy, top_k=top_k,
-        top_p=top_p, temperature=temperature)
+        top_p=top_p, temperature=temperature, draw_base=draw_base,
+        shardings=shardings)
 
     if n_steps == 1:
         (nxt, _, _, k_pages, v_pages, k_scales, v_scales, _) = one_token(
@@ -442,17 +485,17 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps"),
+                     "n_steps", "shardings"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
                          k_pages, v_pages, k_scales, v_scales,
                          tokens, positions, tables, lens, key,
-                         eos_ids, budgets, n_live, *,
+                         draw_base, eos_ids, budgets, n_live, *,
                          eps: float, kvh: int, head_dim: int,
                          transpose_head: bool = False,
                          strategy: str = "greedy_search", top_k: int = 0,
                          top_p: float = 1.0, temperature: float = 1.0,
-                         n_steps: int = 2):
+                         n_steps: int = 2, shardings=None):
     """The split path's ON-DEVICE decode window with EARLY EXIT: up to
     ``n_steps`` tokens per dispatch (same per-step body as
     ``_paged_decode_step`` — ``_decode_one_token_fn`` — so the token
@@ -480,7 +523,8 @@ def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
         stack, norm_w, head_w, embed_w, rope, tables,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy, top_k=top_k,
-        top_p=top_p, temperature=temperature)
+        top_p=top_p, temperature=temperature, draw_base=draw_base,
+        shardings=shardings)
 
     b = tokens.shape[0]
     live = jnp.arange(b) < n_live
@@ -523,11 +567,12 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
                    k_pages, v_pages, k_scales, v_scales,
                    ids, positions, row_tables,
                    q_start, q_len, kv_len, desc_tables,
-                   desc_of_row, off_of_row, key, *,
+                   desc_of_row, off_of_row, key, draw_base=0, *,
                    eps: float, kvh: int, head_dim: int,
                    transpose_head: bool = False,
                    strategy: str = "greedy_search", top_k: int = 0,
-                   top_p: float = 1.0, temperature: float = 1.0):
+                   top_p: float = 1.0, temperature: float = 1.0,
+                   shardings=None):
     """Un-jitted body of ``_paged_mixed_step`` — ALSO the per-step body
     of ``_paged_mixed_window``'s on-device loop, which is what makes
     the scanned window bit-identical to host-chained dispatch: the two
@@ -559,9 +604,9 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
         iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
         hn = _nn.rms_norm(hcur, iln, epsilon=eps)
         nh = _wout(qw) // head_dim
-        q = _mm(hn, qw).reshape(t, nh, head_dim)
-        k = _mm(hn, kw).reshape(t, kvh, head_dim)
-        v = _mm(hn, vw).reshape(t, kvh, head_dim)
+        q = _tpc(_mm(hn, qw).reshape(t, nh, head_dim), shardings, 1)
+        k = _tpc(_mm(hn, kw).reshape(t, kvh, head_dim), shardings, 1)
+        v = _tpc(_mm(hn, vw).reshape(t, kvh, head_dim), shardings, 1)
         qf = q.astype(jnp.float32)
         kf = k.astype(jnp.float32)
         q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
@@ -592,37 +637,45 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
                     ksp[:, :, None, :], vsp[:, :, None, :])
             ksp = ks4.reshape(ksp.shape)
             vsp = vs4.reshape(vsp.shape)
-        hcur = hcur + _mm(attn.reshape(t, nh * head_dim), ow)
+        attn = _tpc(attn, shardings, 1)
+        hcur = _tpc(hcur + _mm(
+            _tpc(attn.reshape(t, nh * head_dim), shardings), ow),
+            shardings)
         hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _nn.silu(_mm(hn, gw)) * _mm(hn, uw)
-        return hcur + _mm(ff, dw), (kp, vp, ksp, vsp)
+        ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
+        return (_tpc(hcur + _mm(_tpc(ff, shardings), dw), shardings),
+                (kp, vp, ksp, vsp))
 
     x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
         layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
-    logits = jnp.matmul(x, head_w.T) if transpose_head \
-        else _mm(x, head_w)
+    logits = _tpc(jnp.matmul(x, head_w.T) if transpose_head
+                  else _mm(x, head_w), shardings)
     key, sub = split_step(key)
+    row_ids = None if strategy == "greedy_search" else \
+        draw_base + jnp.arange(t, dtype=jnp.int32)
     nxt, _ = sample_logits(logits, sub, strategy=strategy,
                            top_k=top_k, top_p=top_p,
-                           temperature=temperature)
+                           temperature=temperature, row_ids=row_ids)
     return nxt, k_pages, v_pages, k_scales, v_scales, key
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
-                     "strategy", "top_k", "top_p", "temperature"),
+                     "strategy", "top_k", "top_p", "temperature",
+                     "shardings"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
                       k_pages, v_pages, k_scales, v_scales,
                       ids, positions, row_tables,
                       q_start, q_len, kv_len, desc_tables,
-                      desc_of_row, off_of_row, key, *,
+                      desc_of_row, off_of_row, key, draw_base=0, *,
                       eps: float, kvh: int, head_dim: int,
                       transpose_head: bool = False,
                       strategy: str = "greedy_search", top_k: int = 0,
-                      top_p: float = 1.0, temperature: float = 1.0):
+                      top_p: float = 1.0, temperature: float = 1.0,
+                      shardings=None):
     """ONE compiled program for the whole MIXED prefill+decode batch
     (the ragged unified step): a flat token batch of T rows — every
     active decode slot contributes 1 row, each pending prefill chunk
@@ -650,29 +703,30 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
         stack, norm_w, head_w, embed_w, rope,
         k_pages, v_pages, k_scales, v_scales,
         ids, positions, row_tables, q_start, q_len, kv_len,
-        desc_tables, desc_of_row, off_of_row, key,
+        desc_tables, desc_of_row, off_of_row, key, draw_base,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy,
-        top_k=top_k, top_p=top_p, temperature=temperature)
+        top_k=top_k, top_p=top_p, temperature=temperature,
+        shardings=shardings)
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps"),
+                     "n_steps", "shardings"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
                         k_pages, v_pages, k_scales, v_scales,
                         ids, positions, row_tables,
                         q_start, q_len, kv_len, desc_tables,
-                        desc_of_row, off_of_row, key,
+                        desc_of_row, off_of_row, key, draw_base,
                         eos_ids, budgets, n_rows, *,
                         eps: float, kvh: int, head_dim: int,
                         transpose_head: bool = False,
                         strategy: str = "greedy_search", top_k: int = 0,
                         top_p: float = 1.0, temperature: float = 1.0,
-                        n_steps: int = 2):
+                        n_steps: int = 2, shardings=None):
     """The unified path's ON-DEVICE decode window: up to ``n_steps``
     pure-decode steps of ``_mixed_forward`` — attend+append (the
     ragged kernel, aliases intact), sample, feed-back — chained in a
@@ -718,10 +772,11 @@ def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
                 stack, norm_w, head_w, embed_w, rope,
                 k_pages, v_pages, k_scales, v_scales,
                 ids, positions, row_tables, q_start, q_len, kv_len,
-                desc_tables, desc_of_row, off_of_row, key,
+                desc_tables, desc_of_row, off_of_row, key, draw_base,
                 eps=eps, kvh=kvh, head_dim=head_dim,
                 transpose_head=transpose_head, strategy=strategy,
-                top_k=top_k, top_p=top_p, temperature=temperature)
+                top_k=top_k, top_p=top_p, temperature=temperature,
+                shardings=shardings)
         nxt = nxt.astype(jnp.int32)
         toks = jax.lax.dynamic_update_slice(toks, nxt[None], (si, 0))
         fresh = jnp.logical_not(done)
@@ -763,7 +818,8 @@ class LLMEngine:
                  swap_pool_pages: Optional[int] = None,
                  unified_step: bool = True,
                  prefill_token_budget: Optional[int] = None,
-                 scan_decode: bool = True):
+                 scan_decode: bool = True,
+                 mesh=None, tp_axis: str = "tp"):
         import jax
         import jax.numpy as jnp
 
@@ -822,6 +878,27 @@ class LLMEngine:
         self.kvh = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         layers = model.llama.layers
+        # tensor-parallel serving (``mesh=``): attention heads and MLP
+        # hidden shard over the ``tp_axis`` of the given 1-D mesh
+        # (distributed.topology.serving_mesh builds one); the paged KV
+        # pools shard on their KV-head axis so each chip holds
+        # num_kv_heads/tp heads of EVERY page.  The plan is a hashable
+        # static jit arg — one extra trace per mesh shape, zero when
+        # mesh is None (the constraints vanish and the programs are
+        # the single-chip ones byte for byte).
+        self._shardings = None
+        if mesh is not None:
+            from ..distributed.sharding import TPShardings
+            self._shardings = TPShardings(mesh, tp_axis)
+            tp = self._shardings.tp
+            nh = c.num_attention_heads
+            enforce(tp >= 1 and mesh.shape.get(tp_axis) is not None,
+                    f"mesh has no {tp_axis!r} axis: {mesh!r}")
+            enforce(self.kvh % tp == 0,
+                    f"tp={tp} must divide num_key_value_heads "
+                    f"({self.kvh}) — each shard holds whole KV heads")
+            enforce(nh % tp == 0,
+                    f"tp={tp} must divide num_attention_heads ({nh})")
         if n_pages is None:
             n_pages = max_seqs * (max_len // page_size) + 1
         if kv_dtype not in (None, "int8"):
@@ -837,7 +914,8 @@ class LLMEngine:
             head_dim=self.head_dim, max_seqs=max_seqs, max_len=max_len,
             dtype=dtype, num_layers=len(layers),
             kv_dtype="int8" if kv_dtype == "int8" else None,
-            swap_pool_pages=swap_pool_pages)
+            swap_pool_pages=swap_pool_pages,
+            shardings=self._shardings)
 
         def stackp(get):
             return jnp.stack([get(l).value for l in layers])
@@ -905,6 +983,40 @@ class LLMEngine:
         else:
             self._rope_prefill = self._rope
 
+        if self._shardings is not None:
+            # commit every program input up front: projection weights
+            # shard on their OUTPUT axis (int8 (values, scales) pairs
+            # travel together), everything that feeds a contraction or
+            # a norm stays replicated — the device_put placements and
+            # the in-graph _tpc constraints are the same plan, so
+            # GSPMD never has to guess (a guessed partial-sum would
+            # break tp=1 vs tp=N bit-identity).
+            sh = self._shardings
+
+            def _put(w, dim):
+                if isinstance(w, tuple):
+                    return tuple(_put(a, dim) for a in w)
+                d = dim if dim is not None and \
+                    w.shape[dim] % sh.tp == 0 else None
+                return sh.put(w, d)
+
+            # stack order: iln, qw, kw, vw, ow, pln, gw, uw, dw —
+            # layernorm weights (0, 5) replicate, projections shard
+            # on the last (output) axis
+            self._stack = tuple(
+                _put(w, None if i in (0, 5) else -1)
+                for i, w in enumerate(self._stack))
+            self._norm_w = _put(self._norm_w, None)
+            self._embed_w = _put(self._embed_w, None)
+            if self._tied:
+                self._head_w = self._embed_w
+            else:
+                self._head_w = _put(self._head_w, None)
+            same_rope = self._rope_prefill is self._rope
+            self._rope = _put(self._rope, None)
+            self._rope_prefill = self._rope if same_rope \
+                else _put(self._rope_prefill, None)
+
         self.requests: Dict[object, GenRequest] = {}
         self._active: List[GenRequest] = []
         self._init_metrics(enable_metrics)
@@ -950,6 +1062,10 @@ class LLMEngine:
             "top_k": self.top_k, "top_p": self.top_p,
             "temperature": self.temperature, "seed": seed,
             "prefix_caching": self.enable_prefix_caching,
+            # deliberately NOT token-affecting (capsule._TOKEN_AFFECTING):
+            # tp=1 and tp=N streams are bit-identical by construction,
+            # so cross-tp replay is allowed — and asserted in tests
+            "tp": self._shardings.tp if self._shardings else 1,
         }
 
     def config_fingerprint(self) -> dict:
@@ -1115,7 +1231,8 @@ class LLMEngine:
                     jnp.int32(min(plen - 1 - base, P - 1)),
                     eps=self.eps, kvh=self.kvh,
                     head_dim=self.head_dim,
-                    transpose_head=self._tied)
+                    transpose_head=self._tied,
+                    shardings=self._shardings)
             chunk_span.end()
         return logits
 
@@ -1158,13 +1275,14 @@ class LLMEngine:
                     self.cache.v_pages, self.cache.k_scales,
                     self.cache.v_scales, jnp.asarray(tokens),
                     jnp.asarray(lens, np.int32), jnp.asarray(tables),
-                    jnp.asarray(lens, np.int32), key,
+                    jnp.asarray(lens, np.int32), key, jnp.int32(0),
                     eps=self.eps, kvh=self.kvh,
                     head_dim=self.head_dim,
                     transpose_head=self._tied,
                     strategy=self.decode_strategy,
                     top_k=self.top_k, top_p=self.top_p,
-                    temperature=self.temperature, n_steps=nsteps)
+                    temperature=self.temperature, n_steps=nsteps,
+                    shardings=self._shardings)
             self.cache.advance([slot], nsteps)
             i += nsteps
 
@@ -1234,10 +1352,13 @@ class LLMEngine:
 
                 self._key, sub = jax.random.split(self._key)
                 from ..nn.generation import sample_logits
+                # row_ids=[0]: the synchronous first token draws as
+                # row 0 — exactly what anchored capsule replay re-folds
                 first_tok, _ = sample_logits(
                     logits[None], sub, strategy=self.decode_strategy,
                     top_k=self.top_k, top_p=self.top_p,
-                    temperature=self.temperature)
+                    temperature=self.temperature,
+                    row_ids=np.zeros(1, np.int32))
                 first = int(np.asarray(first_tok)[0])
         except BaseException:
             # chunked prefill / sampling failed: the slot (and its
@@ -1443,6 +1564,7 @@ class LLMEngine:
                         jnp.asarray(lens, np.int32),
                         jnp.asarray(tables),
                         jnp.asarray(lens, np.int32), sub,
+                        jnp.int32(0),
                         jnp.asarray(eos_ids), jnp.asarray(budgets),
                         jnp.int32(n),
                         eps=self.eps, kvh=self.kvh,
@@ -1450,7 +1572,8 @@ class LLMEngine:
                         transpose_head=self._tied,
                         strategy=self.decode_strategy,
                         top_k=self.top_k, top_p=self.top_p,
-                        temperature=self.temperature, n_steps=nsteps)
+                        temperature=self.temperature, n_steps=nsteps,
+                        shardings=self._shardings)
                 steps_done = int(jax.device_get(steps_d))
             else:
                 (toks, self.cache.k_pages, self.cache.v_pages,
@@ -1464,12 +1587,14 @@ class LLMEngine:
                         jnp.asarray(lens, np.int32),
                         jnp.asarray(tables),
                         jnp.asarray(lens, np.int32), sub,
+                        jnp.int32(0),
                         eps=self.eps, kvh=self.kvh,
                         head_dim=self.head_dim,
                         transpose_head=self._tied,
                         strategy=self.decode_strategy,
                         top_k=self.top_k, top_p=self.top_p,
-                        temperature=self.temperature, n_steps=nsteps)
+                        temperature=self.temperature, n_steps=nsteps,
+                        shardings=self._shardings)
                 steps_done = nsteps
             self.cache.advance(slots, steps_done)
             # [steps_done, n]
@@ -1505,7 +1630,8 @@ class LLMEngine:
                          steps_done,
                          "decode_window"
                          if self.scan_decode and nsteps > 1
-                         else "decode_step")
+                         else "decode_step",
+                         rows={r.rid: i for i, r in enumerate(batch)})
         # TPOT counts only tokens actually DELIVERED to a stream: a
         # request that retired mid-window stops contributing positions
         # (the fixed window-boundary over-count), and the window's
@@ -1664,6 +1790,7 @@ class LLMEngine:
                             jnp.asarray(desc_tables),
                             jnp.asarray(desc_of_row),
                             jnp.asarray(off_of_row), key,
+                            jnp.int32(0),
                             jnp.asarray(eos_ids),
                             jnp.asarray(budgets), jnp.int32(n),
                             eps=self.eps, kvh=self.kvh,
@@ -1672,7 +1799,8 @@ class LLMEngine:
                             strategy=self.decode_strategy,
                             top_k=self.top_k, top_p=self.top_p,
                             temperature=self.temperature,
-                            n_steps=nsteps)
+                            n_steps=nsteps,
+                            shardings=self._shardings)
                     steps_done = int(jax.device_get(steps_d))
                     toks_np = np.asarray(jax.device_get(toks_d))
                     toks_all = [toks_np[j] for j in range(steps_done)]
@@ -1700,12 +1828,14 @@ class LLMEngine:
                                 jnp.asarray(desc_tables),
                                 jnp.asarray(desc_of_row),
                                 jnp.asarray(off_of_row), key,
+                                jnp.int32(0),
                                 eps=self.eps, kvh=self.kvh,
                                 head_dim=self.head_dim,
                                 transpose_head=self._tied,
                                 strategy=self.decode_strategy,
                                 top_k=self.top_k, top_p=self.top_p,
-                                temperature=self.temperature)
+                                temperature=self.temperature,
+                                shardings=self._shardings)
                         nxt = np.asarray(jax.device_get(nxt))
                         toks_all.append(nxt)
                         if n:
@@ -1774,11 +1904,18 @@ class LLMEngine:
         # window's split_step chain, host-chained or scanned)
         cs = _capsule.get_capsule_store()
         if cs.enabled and out:
+            # per-rid draw rows: decode slots are rows 0..n-1 in batch
+            # order; a prefill-finishing first token drew at its chunk's
+            # last flat row — recorded so stochastic replay can re-fold
+            # the exact draw id whatever slot the request decoded in
+            rows = {r.rid: i for i, r in enumerate(batch)}
+            for req, last_row in finishing:
+                rows[req.rid] = int(last_row)
             cs.on_window(out, _sampling.key_fingerprint(sub), nsteps,
                          steps_done,
                          "mixed_window"
                          if self.scan_decode and nsteps > 1
-                         else "mixed_step")
+                         else "mixed_step", rows=rows)
         # TPOT over-count fix: only DELIVERED decode positions advance
         # the histogram / SLO window — a window whose requests all
         # finished early contributes its real token count, not nsteps;
@@ -2178,6 +2315,7 @@ class LLMEngine:
             self.prefix_stats["miss_tokens"]
         snap = {
             "engine": self.engine_id,
+            "tp": self._capsule_fp["tp"],
             "prefill_compiles": self.prefill_compiles(),
             "decode_compiles": self.decode_compiles(),
             "mixed_compiles": self.mixed_compiles(),

@@ -100,7 +100,7 @@ class PagedKVCache:
                  head_dim: int, max_seqs: int, max_len: int,
                  dtype=np.float32, num_layers: int = 1,
                  kv_dtype: Optional[str] = None,
-                 swap_pool_pages: int = 0):
+                 swap_pool_pages: int = 0, shardings=None):
         import jax.numpy as jnp
         enforce(kv_dtype in (None, "int8"),
                 f"unsupported kv_dtype {kv_dtype!r} (None or 'int8')")
@@ -110,6 +110,18 @@ class PagedKVCache:
         self.kv_dtype = kv_dtype
         self.max_pages_per_seq = (max_len + page_size - 1) // page_size
         pool_dtype = jnp.int8 if kv_dtype == "int8" else dtype
+        # tensor-parallel pools (``shardings``: a distributed.sharding
+        # TPShardings plan): the pools commit sharded on the KV-HEAD
+        # axis — each shard holds n_kv_heads/tp heads of EVERY page, so
+        # the page tables, free lists, prefix index and swap plans stay
+        # global (host bookkeeping is tp-agnostic).  jax.device_get on
+        # a sharded pool gathers the full logical array, which is what
+        # keeps swap blobs portable across mesh shapes by construction.
+        self._shardings = shardings
+        if shardings is not None:
+            enforce(n_kv_heads % shardings.tp == 0,
+                    f"tp={shardings.tp} must divide n_kv_heads "
+                    f"({n_kv_heads})")
         # [L, KVH, n_pages, P, D]
         self.k_pages = jnp.zeros((num_layers, n_kv_heads, n_pages,
                                   page_size, head_dim), pool_dtype)
@@ -123,6 +135,16 @@ class PagedKVCache:
         else:
             self.k_scales = None
             self.v_scales = None
+        if shardings is not None:
+            # commit on the mesh, KV-head axis sharded; the serving
+            # programs donate the pools so the placement survives every
+            # step, and eager .at[].set updates (swap-in, import)
+            # re-scatter through it
+            self.k_pages = shardings.put(self.k_pages, 1)
+            self.v_pages = shardings.put(self.v_pages, 1)
+            if self.k_scales is not None:
+                self.k_scales = shardings.put(self.k_scales, 1)
+                self.v_scales = shardings.put(self.v_scales, 1)
         self._free = list(range(n_pages - 1, 0, -1))   # page 0 = pad
         self._pages: Dict[int, List[int]] = {}
         self._lens = np.zeros(max_seqs, np.int32)
@@ -764,10 +786,18 @@ class PagedKVCache:
     def memory_rows(self) -> dict:
         """Memory-plane accounting row (observability.introspection):
         actual bytes held by the device page pools (values + int8 scale
-        planes) and by the host swap pool's staged page copies."""
+        planes) and by the host swap pool's staged page copies.
+
+        Under tensor parallelism ``device_bytes`` stays the GLOBAL
+        logical pool size (``jax.Array.nbytes`` is logical bytes, and
+        fleet aggregation sums these rows — a tp=4 replica must not
+        look 4× cheaper than it is); ``device_bytes_per_shard`` is
+        what one chip's HBM actually holds (the /memz capacity-planning
+        number), with ``tp`` alongside so the division is auditable."""
         dev = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
         if self.k_scales is not None:
             dev += int(self.k_scales.nbytes) + int(self.v_scales.nbytes)
+        tp = self._shardings.tp if self._shardings is not None else 1
         host = 0
         for entry in self._swap.values():
             for arr in (entry.k_host, entry.v_host,
@@ -775,6 +805,8 @@ class PagedKVCache:
                 if arr is not None:
                     host += int(arr.nbytes)
         return {"device_bytes": dev,
+                "device_bytes_per_shard": dev // tp,
+                "tp": tp,
                 "host_bytes": host,
                 "pages": int(self.n_pages),
                 "free_pages": self.free_page_count(),

@@ -20,6 +20,15 @@ exit inside a window (all rows done) skips splits without perturbing
 the engine key, exactly like the host path which simply stops calling
 ``step()``.
 
+Per-ROW draws fold the batch row index into the step subkey
+(``fold_row``), so a request's token stream depends on the key chain
+and its row id but NOT on which other requests share the batch.  The
+live engine always folds the physical row (``draw_base=0`` + row i
+folds i); capsule replay re-pins a request decoded in row r by placing
+it in row 0 and passing ``draw_base=r``, so row 0 folds the original
+r.  Greedy decoding ignores keys entirely, which is why it is
+bit-identical across batch shapes without any of this.
+
 ``sample_logits`` is re-exported so window bodies import their whole
 sampling surface from one place.
 """
@@ -28,7 +37,21 @@ from __future__ import annotations
 from ..nn.generation import sample_logits
 
 __all__ = ["split_step", "window_keys", "key_fingerprint",
-           "key_from_fingerprint", "sample_logits"]
+           "key_from_fingerprint", "sample_logits", "fold_row"]
+
+
+def fold_row(key, row):
+    """Per-row sample key: ``jax.random.fold_in(step_subkey, row)``.
+
+    THE single definition of the row fold — ``sample_logits`` (via
+    ``row_ids=``), the window bodies, and the replay oracle all derive
+    per-row keys here so they cannot drift.  ``row`` is the request's
+    draw id: physical batch row on the live path, the CAPTURED row on
+    replay (threaded in as ``draw_base + row_index``).
+    """
+    import jax
+
+    return jax.random.fold_in(key, row)
 
 
 def split_step(key):

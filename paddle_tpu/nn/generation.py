@@ -108,9 +108,17 @@ def _top_p_filter(logits, p: float):
 
 def sample_logits(logits, key, *, strategy: str = "greedy_search",
                   top_k: int = 0, top_p: float = 1.0,
-                  temperature: float = 1.0):
+                  temperature: float = 1.0, row_ids=None):
     """logits [B, V] -> (token [B] int32, logprob [B] f32).  Pure jax —
-    usable inside scan.  ``key`` ignored for greedy."""
+    usable inside scan.  ``key`` ignored for greedy.
+
+    ``row_ids`` (int32 [B], optional) switches sampling from one
+    batch-wide categorical call to per-row draws with
+    ``fold_row(key, row_ids[i])`` keys, making each row's draw
+    independent of batch packing (the serving engine's replay contract
+    — see inference/sampling.py).  ``None`` keeps the legacy dense
+    behavior used by ``GenerationMixin.generate``.
+    """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if strategy == "greedy_search":
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -122,7 +130,15 @@ def sample_logits(logits, key, *, strategy: str = "greedy_search",
             filt = _top_k_filter(filt, top_k)
         if top_p < 1.0:
             filt = _top_p_filter(filt, top_p)
-        tok = jax.random.categorical(key, filt, axis=-1).astype(jnp.int32)
+        if row_ids is not None:
+            from ..inference.sampling import fold_row  # lazy: no cycle
+            tok = jax.vmap(
+                lambda r, row: jax.random.categorical(
+                    fold_row(key, r), row, axis=-1)
+            )(jnp.asarray(row_ids, jnp.int32), filt).astype(jnp.int32)
+        else:
+            tok = jax.random.categorical(key, filt,
+                                         axis=-1).astype(jnp.int32)
     lp = jnp.take_along_axis(logp, tok[:, None].astype(jnp.int32),
                              axis=-1)[:, 0]
     return tok, lp

@@ -112,7 +112,8 @@ class _NullCapsuleStore:
     def begin(self, rid, **kw):
         pass
 
-    def on_window(self, out, key_words, n_steps, steps_done, path):
+    def on_window(self, out, key_words, n_steps, steps_done, path,
+                  rows=None):
         pass
 
     def annotate(self, rid, timeline=None, trace_id=None,
@@ -211,14 +212,18 @@ class CapsuleStore:
                 self.counters["evicted_total"] += 1
 
     def on_window(self, out: Dict[object, List[int]], key_words,
-                  n_steps: int, steps_done: int, path: str):
+                  n_steps: int, steps_done: int, path: str,
+                  rows: Optional[Dict[object, int]] = None):
         """Record one decode window for every captured rid it
         delivered tokens to: the window's forked key (the anchor of
         its in-window ``split_step`` chain), the STATIC dispatch size
         ``n_steps``, the early-exit ``steps_done``, how many tokens
-        THIS rid took from it, and which compiled path ran.  The
-        delivered tokens extend the capsule's stream — the capsule
-        always mirrors ``req.out``."""
+        THIS rid took from it, which compiled path ran, and — via
+        ``rows`` — the BATCH ROW the rid occupied.  The row is what
+        lets stochastic replay re-fold the request's exact per-row
+        draw id whatever slot it decoded in (the carried row>0 gap);
+        greedy replay never reads it.  The delivered tokens extend the
+        capsule's stream — the capsule always mirrors ``req.out``."""
         with self._lock:
             for rid, toks in out.items():
                 cap = self._ring.get(rid)
@@ -227,7 +232,9 @@ class CapsuleStore:
                 cap["windows"].append({
                     "key": key_words, "n_steps": int(n_steps),
                     "steps_done": int(steps_done),
-                    "n_toks": len(toks), "path": path})
+                    "n_toks": len(toks), "path": path,
+                    "row": int(rows[rid]) if rows and rid in rows
+                    else 0})
                 cap["tokens"].extend(int(t) for t in toks)
 
     def annotate(self, rid, timeline=None, trace_id=None,
@@ -492,7 +499,11 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
         return report
     prompt = [int(t) for t in capsule["prompt"]]
     strategy = fp.get("decode_strategy", engine.decode_strategy)
-    if strategy != "greedy_search":
+    if strategy != "greedy_search" and any(
+            "row" not in w for w in capsule.get("windows") or []):
+        # legacy capsule without per-window rows: draws recorded in a
+        # non-zero batch row cannot be re-folded — row-0 capsules still
+        # replay exactly, everything else may diverge (expected)
         report["notes"].append("sampling_replay_row0_only")
 
     from ..inference import engine as _eng
@@ -524,12 +535,14 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
             else:
                 sub = _sampling.key_from_fingerprint(
                     capsule["key_anchor"])
+                # row_ids=[0]: the live add_request draw folded row 0
                 tok, _ = _sampling.sample_logits(
                     logits[None], sub, strategy=strategy,
                     top_k=fp.get("top_k", engine.top_k),
                     top_p=fp.get("top_p", engine.top_p),
                     temperature=fp.get("temperature",
-                                       engine.temperature))
+                                       engine.temperature),
+                    row_ids=np.zeros(1, np.int32))
                 first = int(np.asarray(tok)[0])
             report["steps_compared"] = 1
             if first != exp[0]:
@@ -551,17 +564,22 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
                     n = min(engine.steps_per_sync, len(exp) - j)
                     while n & (n - 1):
                         n &= n - 1
-                    yield n, n, jax.random.PRNGKey(0)
+                    yield n, n, jax.random.PRNGKey(0), 0
                     j += n
         else:
+            # each window carries the batch ROW the request occupied
+            # (it can move between windows as neighbors retire):
+            # replaying in row 0 with draw_base=row re-folds the exact
+            # live draw id — the carried row>0 stochastic-replay gap
             def plan():
                 for w in capsule.get("windows") or []:
                     yield w["n_steps"], w["n_toks"], \
-                        _sampling.key_from_fingerprint(w["key"])
+                        _sampling.key_from_fingerprint(w["key"]), \
+                        int(w.get("row", 0))
         pad = engine.max_seqs - 1
         padt = np.zeros((pad,) + engine.cache.page_table.shape[1:],
                         np.int32)
-        for n_steps, take, key in plan():
+        for n_steps, take, key, draw_row in plan():
             if i >= len(exp) or take == 0:
                 continue
             take = min(take, len(exp) - i)
@@ -607,7 +625,8 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
                     engine.cache.k_scales, engine.cache.v_scales,
                     jnp.asarray(tokens), jnp.asarray(lens, np.int32),
                     jnp.asarray(tables), jnp.asarray(lens, np.int32),
-                    key, eps=engine.eps, kvh=engine.kvh,
+                    key, jnp.int32(draw_row),
+                    eps=engine.eps, kvh=engine.kvh,
                     head_dim=engine.head_dim,
                     transpose_head=engine._tied,
                     strategy=strategy,
@@ -615,7 +634,8 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
                     top_p=fp.get("top_p", engine.top_p),
                     temperature=fp.get("temperature",
                                        engine.temperature),
-                    n_steps=n_steps)
+                    n_steps=n_steps,
+                    shardings=engine._shardings)
             got = np.asarray(jax.device_get(toks))[:, 0]
             for j in range(take):
                 report["steps_compared"] += 1

@@ -591,7 +591,16 @@ def memory_brief() -> dict:
                       for r in consumers.values())
     host_pool = sum(int(r.get("host_bytes") or 0)
                     for r in consumers.values())
+    # per-chip HBM view for tensor-parallel pools: a consumer that
+    # reports device_bytes_per_shard (sharded KV pools) contributes
+    # that; unsharded rows contribute their full device_bytes — so the
+    # gauge answers "what does ONE chip hold", while device_pool_bytes
+    # stays the global logical total the fleet sums
+    per_shard = sum(int(r.get("device_bytes_per_shard",
+                              r.get("device_bytes")) or 0)
+                    for r in consumers.values())
     out = {"device_pool_bytes": device_pool,
+           "device_pool_bytes_per_shard": per_shard,
            "host_pool_bytes": host_pool,
            "checkpoint_staging": _staging_row(walk=False)}
     devices = device_memory_rows()

@@ -50,6 +50,25 @@ so a long prompt split across several descriptors in one dispatch sees
 its earlier chunks' pages already written.  The jnp mirror
 (``ragged_paged_append_attend_reference``) is the CPU/oracle path the
 engine's mixed-step program uses off-TPU.
+
+TENSOR-PARALLEL SERVING (engine ``mesh=``/``tp_axis=``): the engine
+shards the page pools on the KVH axis (dim 0 here after the layer
+stack is unstacked) and the query/new-KV projections on the head axis,
+so under GSPMD each shard's kernel dispatch sees a self-contained
+problem — KVH/tp heads of EVERY page, with the (sequence, kv-head)
+grid partitioning trivially along its second axis and zero cross-chip
+traffic inside the kernel (page tables and seq_lens are replicated
+scalars/int32 vectors).  Nothing in this file needs a mesh: a
+``pallas_call`` is opaque to GSPMD, so the partitioning happens at the
+engine-program level via ``with_sharding_constraint`` on the kernel's
+operands (pools constrained on KVH, q/k_new/v_new on the head dim),
+which makes XLA shard the dispatch rather than the kernel body.  The
+per-token scale pools ride the same KVH sharding, so the int8 path's
+~2× HBM saving multiplies the tp capacity win instead of fighting it.
+The jnp reference paths below are likewise head-parallel by
+construction (every einsum/gather is elementwise or contracted over
+D/S only, never over KVH), which is what makes the CPU mesh tests
+bit-exact vs tp=1.
 """
 from __future__ import annotations
 

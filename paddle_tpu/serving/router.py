@@ -725,12 +725,22 @@ class ReplicaRouter:
                 name: dict(st, compile_seconds=round(
                     st["compile_seconds"], 6))
                 for name, st in sorted(compile_fleet.items())}
-        # memory-plane federation: pool bytes sum across replicas
+        # memory-plane federation: pool bytes sum across replicas.
+        # device_pool_bytes sums the GLOBAL logical pools (a tp=4
+        # replica's sharded KV pool counts once at full size — it must
+        # not look 4× cheaper); device_pool_bytes_per_shard sums the
+        # per-chip footprints (capacity planning: what each replica
+        # asks of one chip's HBM), falling back to the global figure
+        # for replicas predating the field
         mems = [s.get("memory") for s in fresh if s.get("memory")]
         if mems:
             fleet["memory"] = {
                 "device_pool_bytes": sum(
                     int(m.get("device_pool_bytes") or 0) for m in mems),
+                "device_pool_bytes_per_shard": sum(
+                    int(m.get("device_pool_bytes_per_shard",
+                              m.get("device_pool_bytes")) or 0)
+                    for m in mems),
                 "host_pool_bytes": sum(
                     int(m.get("host_pool_bytes") or 0) for m in mems),
                 "checkpoint_staging_dirs": sum(

@@ -27,6 +27,23 @@ def pytest_configure(config):
         "from the tier-1 `-m 'not slow'` run")
 
 
+def requires_mesh(n):
+    """Skip marker for tests that need ``n`` devices for a tp mesh
+    (``from conftest import requires_mesh``).
+
+    The root conftest forces an 8-device CPU platform
+    (``--xla_force_host_platform_device_count=8``), so any tp <= 8
+    normally runs everywhere; the guard only fires when an environment
+    overrides XLA_FLAGS down to fewer host devices.
+    """
+    import jax
+
+    return pytest.mark.skipif(
+        len(jax.devices()) < n,
+        reason=f"needs >= {n} devices for a tp={n} mesh",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_fleet_state():
     yield
