@@ -1,8 +1,12 @@
 from .predictor import Config, PredictorTensor, Predictor, create_predictor
 from .paged_cache import PagedKVCache
+from .backbone import BackboneSpec, register_backbone, resolve_backbone
+from .moe_dispatch import MoEArch, moe_ffn
 from .engine import GenRequest, LLMEngine
 from .sampling import sample_logits, split_step, window_keys
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
            "PagedKVCache", "LLMEngine", "GenRequest",
+           "BackboneSpec", "register_backbone", "resolve_backbone",
+           "MoEArch", "moe_ffn",
            "sample_logits", "split_step", "window_keys"]

@@ -79,6 +79,24 @@ over the uncached tail — shared system prompts / few-shot templates
 prefill ONCE and cost one set of pages however many requests carry
 them.  Sharing is page-table indirection only: the prefill/decode
 programs are unchanged, so ``prefill_compiles() == 1`` still holds.
+
+MoE serving (Qwen2-MoE/DeepSeekMoE backbones): the model resolves
+through the backbone seam (inference/backbone.py) instead of the old
+hardwired ``model.llama.*`` reads, and every serving program gains a
+static ``arch`` argument — ``None`` keeps the Llama trace byte
+identical; an :class:`~.moe_dispatch.MoEArch` switches the decoder
+FFN to the top-k routed + shared-expert path (inference/
+moe_dispatch.py): ONE grouped matmul dispatch per projection per
+layer over the sorted dropless layout, or the dense per-row
+reference (``moe_dispatch="dense"``), bit-identical on CPU.  Routing
+descriptors are traced data, so every one-compile invariant above
+survives; the programs additionally return per-layer-per-expert
+routed-token counts feeding the ``llm_engine_expert_tokens_total``
+observability plane.  Capacity-factor dispatch (``moe_dropless=
+False``) drops per page-group deterministically across the
+split/unified/scanned paths (the unified planner packs whole page
+chunks in that mode); decode rows are singleton groups and never
+drop.
 """
 from __future__ import annotations
 
@@ -171,7 +189,7 @@ def _tpc(x, shardings, dim=None):
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
-                     "shardings"),
+                     "shardings", "arch"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
                          k_pages, v_pages, k_scales, v_scales,
@@ -179,7 +197,7 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
                          page_slot, last_in_chunk, *, eps: float,
                          kvh: int, head_dim: int,
                          transpose_head: bool = False,
-                         shardings=None):
+                         shardings=None, arch=None):
     """CHUNKED ragged prefill (round 5): process ``ids`` [C] — one
     page-sized chunk of ONE prompt — against the paged cache.  Each
     chunk's K/V fill exactly one page (C == page_size), written with a
@@ -208,7 +226,11 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
     page_slot the pool index this chunk writes; last_in_chunk =
     clamp(plen-1 - chunk_base, 0, C-1) (the row whose logits matter
     on the final chunk).  Returns (logits [V], k_pages', v_pages',
-    k_scales', v_scales').
+    k_scales', v_scales') — plus per-layer expert counts [L, E] when
+    ``arch`` is an MoE dispatch config (static; None = dense Llama
+    FFN, byte-identical to the pre-MoE trace).  MoE routing masks the
+    end-padding rows (``> last_in_chunk``) out of the dispatch and
+    counts; the chunk is one capacity page-group.
     """
     import jax
     import jax.numpy as jnp
@@ -256,15 +278,30 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
                        v_full.astype(jnp.float32))
         return o.reshape(c, q.shape[1], head_dim).astype(q.dtype)
 
+    if arch is not None:
+        from .moe_dispatch import moe_ffn
+        # MoE routing sees only the chunk's REAL rows; the chunk is
+        # one capacity page-group starting at row 0
+        moe_live = jnp.arange(c) <= last_in_chunk
+        moe_group = jnp.zeros(c, jnp.int32)
+
     def layer(carry, xs):
         hcur = carry
         lp, kp, vp, ksp, vsp = xs             # params + per-layer pools
-        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        if arch is None:
+            iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+            qb = kb = vb = None
+        else:
+            (iln, qw, qb, kw, kb, vw, vb, ow, pln, rw, egw, euw, edw,
+             sgw, suw, sdw, seg) = lp
         hn = _nn.rms_norm(hcur, iln, epsilon=eps)
         nh = _wout(qw) // head_dim
-        q = _tpc(_mm(hn, qw).reshape(c, nh, head_dim), shardings, 1)
-        k = _tpc(_mm(hn, kw).reshape(c, kvh, head_dim), shardings, 1)
-        v = _tpc(_mm(hn, vw).reshape(c, kvh, head_dim), shardings, 1)
+        qx, kx, vx = _mm(hn, qw), _mm(hn, kw), _mm(hn, vw)
+        if arch is not None and arch.attn_bias:
+            qx, kx, vx = qx + qb, kx + kb, vx + vb
+        q = _tpc(qx.reshape(c, nh, head_dim), shardings, 1)
+        k = _tpc(kx.reshape(c, kvh, head_dim), shardings, 1)
+        v = _tpc(vx.reshape(c, kvh, head_dim), shardings, 1)
         qf, kf = q.astype(jnp.float32)[None], k.astype(jnp.float32)[None]
         q = (qf * cos + rotate_half(qf) * sin)[0].astype(q.dtype)
         k = (kf * cos + rotate_half(kf) * sin)[0].astype(k.dtype)
@@ -310,23 +347,37 @@ def _paged_prefill_chunk(stack, norm_w, head_w, embed_w, rope,
         hcur = _tpc(hcur + _mm(_tpc(attn.reshape(c, nh * head_dim),
                                     shardings), ow), shardings)
         hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
-        return (_tpc(hcur + _mm(_tpc(ff, shardings), dw), shardings),
-                (kp, vp, ksp, vsp))
+        if arch is None:
+            ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
+            return (_tpc(hcur + _mm(_tpc(ff, shardings), dw),
+                         shardings), (kp, vp, ksp, vsp))
+        ff, cnt = moe_ffn(hn, (rw, egw, euw, edw, sgw, suw, sdw, seg),
+                          arch, moe_live, moe_group)
+        return (_tpc(hcur + ff, shardings), (kp, vp, ksp, vsp, cnt))
 
-    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
-        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    if arch is None:
+        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            layer, x,
+            (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    else:
+        x, (k_pages, v_pages, k_scales, v_scales, counts) = \
+            jax.lax.scan(
+                layer, x,
+                (tuple(stack), k_pages, v_pages, k_scales, v_scales))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
     xl = jnp.take(x, last_in_chunk, axis=0)   # [H]
     logits = _tpc(jnp.matmul(xl, head_w.T) if transpose_head
                   else _mm(xl, head_w), shardings)
-    return logits, k_pages, v_pages, k_scales, v_scales
+    if arch is None:
+        return logits, k_pages, v_pages, k_scales, v_scales
+    return logits, k_pages, v_pages, k_scales, v_scales, counts
 
 
 def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                          *, eps, kvh, head_dim, transpose_head,
                          strategy, top_k, top_p, temperature,
-                         draw_base=None, shardings=None):
+                         draw_base=None, shardings=None, arch=None,
+                         live=None):
     """Build the one-token decode body shared by ``_paged_decode_step``
     (fixed-length window) and ``_paged_decode_window`` (the early-exit
     scanned window).  ONE definition of the per-step math — embed,
@@ -342,7 +393,12 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
 
     carry: (tokens [B], positions [B], lens [B], k_pages, v_pages,
     k_scales, v_scales, key) → the same tuple one step later, with the
-    sampled token in slot 0.
+    sampled token in slot 0.  With an MoE ``arch`` the carry gains a
+    trailing ``counts_acc`` [L, E] int32 accumulator and ``live`` [B]
+    (from the WINDOW-START lens — pad rows stay masked for the whole
+    window) gates which rows route; decode rows are singleton capacity
+    groups, so ``group_start=None`` (never drop — top-k experts are
+    distinct).
     """
     import jax
     import jax.numpy as jnp
@@ -365,9 +421,16 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
     append_attend = paged_decode_append_attend_raw \
         if is_compiled_with_tpu() else paged_decode_append_attend_reference
 
+    if arch is not None:
+        from .moe_dispatch import moe_ffn
+
     def one_token(carry):
-        (tokens, positions, lens, k_pages, v_pages, k_scales, v_scales,
-         key) = carry
+        if arch is None:
+            (tokens, positions, lens, k_pages, v_pages, k_scales,
+             v_scales, key) = carry
+        else:
+            (tokens, positions, lens, k_pages, v_pages, k_scales,
+             v_scales, key, counts_acc) = carry
         b = tokens.shape[0]
         x = jnp.take(embed_w, tokens, axis=0)  # [B, H]
         cos = jnp.take(cos_t, positions, axis=0)[:, None, :]  # [B,1,D]
@@ -376,12 +439,20 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
         def layer(carry, xs):
             hcur = carry
             lp, kp, vp, ksp, vsp = xs          # per-layer params + pools
-            iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+            if arch is None:
+                iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+                qb = kb = vb = None
+            else:
+                (iln, qw, qb, kw, kb, vw, vb, ow, pln, rw, egw, euw,
+                 edw, sgw, suw, sdw, seg) = lp
             hn = _nn.rms_norm(hcur, iln, epsilon=eps)
             nh = _wout(qw) // head_dim
-            q = _tpc(_mm(hn, qw).reshape(b, nh, head_dim), shardings, 1)
-            k = _tpc(_mm(hn, kw).reshape(b, kvh, head_dim), shardings, 1)
-            v = _tpc(_mm(hn, vw).reshape(b, kvh, head_dim), shardings, 1)
+            qx, kx, vx = _mm(hn, qw), _mm(hn, kw), _mm(hn, vw)
+            if arch is not None and arch.attn_bias:
+                qx, kx, vx = qx + qb, kx + kb, vx + vb
+            q = _tpc(qx.reshape(b, nh, head_dim), shardings, 1)
+            k = _tpc(kx.reshape(b, kvh, head_dim), shardings, 1)
+            v = _tpc(vx.reshape(b, kvh, head_dim), shardings, 1)
             qf = q.astype(jnp.float32)
             kf = k.astype(jnp.float32)
             q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
@@ -402,13 +473,25 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                 _tpc(attn.reshape(b, nh * head_dim), shardings), ow),
                 shardings)
             hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-            ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
-            return (_tpc(hcur + _mm(_tpc(ff, shardings), dw),
-                         shardings), (kp, vp, ksp, vsp))
+            if arch is None:
+                ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw),
+                          shardings, 1)
+                return (_tpc(hcur + _mm(_tpc(ff, shardings), dw),
+                             shardings), (kp, vp, ksp, vsp))
+            ff, cnt = moe_ffn(hn, (rw, egw, euw, edw, sgw, suw, sdw,
+                                   seg), arch, live)
+            return (_tpc(hcur + ff, shardings),
+                    (kp, vp, ksp, vsp, cnt))
 
-        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
-            layer, x, (tuple(stack), k_pages, v_pages, k_scales,
-                       v_scales))
+        if arch is None:
+            x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+                layer, x, (tuple(stack), k_pages, v_pages, k_scales,
+                           v_scales))
+        else:
+            x, (k_pages, v_pages, k_scales, v_scales, cnts) = \
+                jax.lax.scan(
+                    layer, x, (tuple(stack), k_pages, v_pages,
+                               k_scales, v_scales))
         x = _nn.rms_norm(x, norm_w, epsilon=eps)
         logits = _tpc(jnp.matmul(x, head_w.T) if transpose_head
                       else _mm(x, head_w), shardings)
@@ -419,8 +502,11 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                                top_k=top_k, top_p=top_p,
                                temperature=temperature,
                                row_ids=row_ids)
+        if arch is None:
+            return (nxt, positions + 1, lens + 1, k_pages, v_pages,
+                    k_scales, v_scales, key)
         return (nxt, positions + 1, lens + 1, k_pages, v_pages,
-                k_scales, v_scales, key)
+                k_scales, v_scales, key, counts_acc + cnts)
 
     return one_token
 
@@ -429,7 +515,7 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps", "shardings"),
+                     "n_steps", "shardings", "arch"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                        k_pages, v_pages, k_scales, v_scales,
@@ -439,7 +525,7 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
                        transpose_head: bool = False,
                        strategy: str = "greedy_search", top_k: int = 0,
                        top_p: float = 1.0, temperature: float = 1.0,
-                       n_steps: int = 1, shardings=None):
+                       n_steps: int = 1, shardings=None, arch=None):
     """``n_steps`` decode tokens for every active sequence as ONE XLA
     program (multi-step scheduling: the host syncs — EOS checks,
     admission — every n_steps tokens, so dispatch latency amortizes
@@ -447,45 +533,56 @@ def _paged_decode_step(stack, norm_w, head_w, embed_w, rope,
     caller).
 
     stack: 9 arrays [L, ...] (decoder weights, _decoder_layer_raw
-    order; weight-only-int8 entries are (values, scale) pairs);
-    k/v_pages [L, KVH, n_pages, P, D]; k/v_scales [L, KVH, n_pages, P]
-    f32 per-token dequant scales for int8 pools (None for fp); tokens
-    [B] int32; positions [B] (= current lengths); tables [B, maxp];
-    lens [B].  Returns (tokens [n_steps, B], k_pages', v_pages',
-    k_scales', v_scales').
+    order; weight-only-int8 entries are (values, scale) pairs) — or 17
+    with an MoE ``arch`` (see LLMEngine.__init__); k/v_pages
+    [L, KVH, n_pages, P, D]; k/v_scales [L, KVH, n_pages, P] f32
+    per-token dequant scales for int8 pools (None for fp); tokens [B]
+    int32; positions [B] (= current lengths); tables [B, maxp]; lens
+    [B].  Returns (tokens [n_steps, B], k_pages', v_pages', k_scales',
+    v_scales') — plus a trailing routed-token counts [L, E] int32 when
+    ``arch`` is an MoE (pad rows, lens == 0, route nowhere).
     """
     import jax
+    import jax.numpy as jnp
 
+    live = None if arch is None else lens > 0
     one_token = _decode_one_token_fn(
         stack, norm_w, head_w, embed_w, rope, tables,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy, top_k=top_k,
         top_p=top_p, temperature=temperature, draw_base=draw_base,
-        shardings=shardings)
+        shardings=shardings, arch=arch, live=live)
+
+    carry0 = (tokens, positions, lens, k_pages, v_pages, k_scales,
+              v_scales, key)
+    if arch is not None:
+        carry0 = carry0 + (jnp.zeros(
+            (stack[0].shape[0], arch.num_experts), jnp.int32),)
 
     if n_steps == 1:
-        (nxt, _, _, k_pages, v_pages, k_scales, v_scales, _) = one_token(
-            (tokens, positions, lens, k_pages, v_pages, k_scales,
-             v_scales, key))
-        return nxt[None], k_pages, v_pages, k_scales, v_scales
+        out = one_token(carry0)
+        (nxt, _, _, k_pages, v_pages, k_scales, v_scales, _) = out[:8]
+        if arch is None:
+            return nxt[None], k_pages, v_pages, k_scales, v_scales
+        return (nxt[None], k_pages, v_pages, k_scales, v_scales,
+                out[8])
 
     def body(carry, _):
         carry = one_token(carry)
         return carry, carry[0]
 
-    ((_, _, _, k_pages, v_pages, k_scales, v_scales, _), toks) = \
-        jax.lax.scan(
-            body, (tokens, positions, lens, k_pages, v_pages, k_scales,
-                   v_scales, key),
-            None, length=n_steps)
-    return toks, k_pages, v_pages, k_scales, v_scales
+    (final, toks) = jax.lax.scan(body, carry0, None, length=n_steps)
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, _) = final[:8]
+    if arch is None:
+        return toks, k_pages, v_pages, k_scales, v_scales
+    return toks, k_pages, v_pages, k_scales, v_scales, final[8]
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps", "shardings"),
+                     "n_steps", "shardings", "arch"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
                          k_pages, v_pages, k_scales, v_scales,
@@ -495,7 +592,7 @@ def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
                          transpose_head: bool = False,
                          strategy: str = "greedy_search", top_k: int = 0,
                          top_p: float = 1.0, temperature: float = 1.0,
-                         n_steps: int = 2, shardings=None):
+                         n_steps: int = 2, shardings=None, arch=None):
     """The split path's ON-DEVICE decode window with EARLY EXIT: up to
     ``n_steps`` tokens per dispatch (same per-step body as
     ``_paged_decode_step`` — ``_decode_one_token_fn`` — so the token
@@ -514,22 +611,28 @@ def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
     bucket).  Returns (tokens [n_steps, B] — rows ≥ steps_done are
     zero-filled, the host must slice with steps_done —, emitted [B]
     int32 per-row delivered-token counts, steps_done, k_pages',
-    v_pages', k_scales', v_scales').
+    v_pages', k_scales', v_scales') — plus a trailing routed-token
+    counts [L, E] int32 with an MoE ``arch`` (rows with window-start
+    ``lens == 0`` route nowhere for the whole window).
     """
     import jax
     import jax.numpy as jnp
 
+    moe_live = None if arch is None else lens > 0
     one_token = _decode_one_token_fn(
         stack, norm_w, head_w, embed_w, rope, tables,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy, top_k=top_k,
         top_p=top_p, temperature=temperature, draw_base=draw_base,
-        shardings=shardings)
+        shardings=shardings, arch=arch, live=moe_live)
 
     b = tokens.shape[0]
     live = jnp.arange(b) < n_live
     state0 = (tokens, positions, lens, k_pages, v_pages, k_scales,
               v_scales, key)
+    if arch is not None:
+        state0 = state0 + (jnp.zeros(
+            (stack[0].shape[0], arch.num_experts), jnp.int32),)
     toks0 = jnp.zeros((n_steps, b), jnp.int32)
     carry0 = (jnp.zeros((), jnp.int32), state0, toks0,
               jnp.logical_not(live), jnp.zeros(b, jnp.int32))
@@ -559,8 +662,12 @@ def _paged_decode_window(stack, norm_w, head_w, embed_w, rope,
 
     si, state, toks, done, emitted = jax.lax.while_loop(
         cond, body, carry0)
-    (_, _, _, k_pages, v_pages, k_scales, v_scales, _) = state
-    return (toks, emitted, si, k_pages, v_pages, k_scales, v_scales)
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, _) = state[:8]
+    if arch is None:
+        return (toks, emitted, si, k_pages, v_pages, k_scales,
+                v_scales)
+    return (toks, emitted, si, k_pages, v_pages, k_scales, v_scales,
+            state[8])
 
 
 def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
@@ -572,12 +679,17 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
                    transpose_head: bool = False,
                    strategy: str = "greedy_search", top_k: int = 0,
                    top_p: float = 1.0, temperature: float = 1.0,
-                   shardings=None):
+                   shardings=None, arch=None):
     """Un-jitted body of ``_paged_mixed_step`` — ALSO the per-step body
     of ``_paged_mixed_window``'s on-device loop, which is what makes
     the scanned window bit-identical to host-chained dispatch: the two
     paths trace the very same ops in the very same order (see
-    ``_paged_mixed_step`` for the argument contract)."""
+    ``_paged_mixed_step`` for the argument contract).  With an MoE
+    ``arch`` the return gains a trailing routed-token counts [L, E]:
+    rows past their descriptor's ``q_len`` (padding) route nowhere,
+    and each descriptor is one capacity page-group (``group_start =
+    q_start[desc_of_row]``) so split-path prefill chunks rank
+    identically."""
     import jax
     import jax.numpy as jnp
 
@@ -597,16 +709,28 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
     cos = jnp.take(cos_t, positions, axis=0)[:, None, :]   # [T, 1, D]
     sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
     on_tpu = is_compiled_with_tpu()
+    if arch is not None:
+        from .moe_dispatch import moe_ffn
+        moe_live = off_of_row < jnp.take(q_len, desc_of_row)
+        moe_group = jnp.take(q_start, desc_of_row)
 
     def layer(carry, xs):
         hcur = carry
         lp, kp, vp, ksp, vsp = xs              # per-layer params + pools
-        iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+        if arch is None:
+            iln, qw, kw, vw, ow, pln, gw, uw, dw = lp
+            qb = kb = vb = None
+        else:
+            (iln, qw, qb, kw, kb, vw, vb, ow, pln, rw, egw, euw,
+             edw, sgw, suw, sdw, seg) = lp
         hn = _nn.rms_norm(hcur, iln, epsilon=eps)
         nh = _wout(qw) // head_dim
-        q = _tpc(_mm(hn, qw).reshape(t, nh, head_dim), shardings, 1)
-        k = _tpc(_mm(hn, kw).reshape(t, kvh, head_dim), shardings, 1)
-        v = _tpc(_mm(hn, vw).reshape(t, kvh, head_dim), shardings, 1)
+        qx, kx, vx = _mm(hn, qw), _mm(hn, kw), _mm(hn, vw)
+        if arch is not None and arch.attn_bias:
+            qx, kx, vx = qx + qb, kx + kb, vx + vb
+        q = _tpc(qx.reshape(t, nh, head_dim), shardings, 1)
+        k = _tpc(kx.reshape(t, kvh, head_dim), shardings, 1)
+        v = _tpc(vx.reshape(t, kvh, head_dim), shardings, 1)
         qf = q.astype(jnp.float32)
         kf = k.astype(jnp.float32)
         q = (qf * cos + rotate_half(qf) * sin).astype(q.dtype)
@@ -642,12 +766,23 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
             _tpc(attn.reshape(t, nh * head_dim), shardings), ow),
             shardings)
         hn = _nn.rms_norm(hcur, pln, epsilon=eps)
-        ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw), shardings, 1)
-        return (_tpc(hcur + _mm(_tpc(ff, shardings), dw), shardings),
-                (kp, vp, ksp, vsp))
+        if arch is None:
+            ff = _tpc(_nn.silu(_mm(hn, gw)) * _mm(hn, uw),
+                      shardings, 1)
+            return (_tpc(hcur + _mm(_tpc(ff, shardings), dw),
+                         shardings), (kp, vp, ksp, vsp))
+        ff, cnt = moe_ffn(hn, (rw, egw, euw, edw, sgw, suw, sdw, seg),
+                          arch, moe_live, moe_group)
+        return (_tpc(hcur + ff, shardings), (kp, vp, ksp, vsp, cnt))
 
-    x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
-        layer, x, (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    if arch is None:
+        x, (k_pages, v_pages, k_scales, v_scales) = jax.lax.scan(
+            layer, x,
+            (tuple(stack), k_pages, v_pages, k_scales, v_scales))
+    else:
+        x, (k_pages, v_pages, k_scales, v_scales, cnts) = jax.lax.scan(
+            layer, x,
+            (tuple(stack), k_pages, v_pages, k_scales, v_scales))
     x = _nn.rms_norm(x, norm_w, epsilon=eps)
     logits = _tpc(jnp.matmul(x, head_w.T) if transpose_head
                   else _mm(x, head_w), shardings)
@@ -657,14 +792,16 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
     nxt, _ = sample_logits(logits, sub, strategy=strategy,
                            top_k=top_k, top_p=top_p,
                            temperature=temperature, row_ids=row_ids)
-    return nxt, k_pages, v_pages, k_scales, v_scales, key
+    if arch is None:
+        return nxt, k_pages, v_pages, k_scales, v_scales, key
+    return nxt, k_pages, v_pages, k_scales, v_scales, key, cnts
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "shardings"),
+                     "shardings", "arch"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
                       k_pages, v_pages, k_scales, v_scales,
@@ -675,7 +812,7 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
                       transpose_head: bool = False,
                       strategy: str = "greedy_search", top_k: int = 0,
                       top_p: float = 1.0, temperature: float = 1.0,
-                      shardings=None):
+                      shardings=None, arch=None):
     """ONE compiled program for the whole MIXED prefill+decode batch
     (the ragged unified step): a flat token batch of T rows — every
     active decode slot contributes 1 row, each pending prefill chunk
@@ -698,7 +835,8 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
     Dead padding rows carry position 0 and the all-zero table — their
     writes land in the reserved pad page.  Returns (next_token [T],
     k_pages', v_pages', k_scales', v_scales', key') — the key chains
-    across host-driven multi-token windows."""
+    across host-driven multi-token windows.  With an MoE ``arch`` the
+    return gains a trailing routed-token counts [L, E]."""
     return _mixed_forward(
         stack, norm_w, head_w, embed_w, rope,
         k_pages, v_pages, k_scales, v_scales,
@@ -707,14 +845,14 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy,
         top_k=top_k, top_p=top_p, temperature=temperature,
-        shardings=shardings)
+        shardings=shardings, arch=arch)
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "n_steps", "shardings"),
+                     "n_steps", "shardings", "arch"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
                         k_pages, v_pages, k_scales, v_scales,
@@ -726,7 +864,7 @@ def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
                         transpose_head: bool = False,
                         strategy: str = "greedy_search", top_k: int = 0,
                         top_p: float = 1.0, temperature: float = 1.0,
-                        n_steps: int = 2, shardings=None):
+                        n_steps: int = 2, shardings=None, arch=None):
     """The unified path's ON-DEVICE decode window: up to ``n_steps``
     pure-decode steps of ``_mixed_forward`` — attend+append (the
     ragged kernel, aliases intact), sample, feed-back — chained in a
@@ -746,17 +884,23 @@ def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
     constant 1 for live rows across the loop.  Returns
     (tokens [n_steps, T] — step rows ≥ steps_done zero-filled —,
     emitted [T] per-row delivered counts, steps_done, k_pages',
-    v_pages', k_scales', v_scales', key')."""
+    v_pages', k_scales', v_scales', key') — plus a trailing
+    routed-token counts [L, E] with an MoE ``arch`` (accumulated over
+    the whole window, retired rows included, exactly like the
+    host-chained path's per-step accumulation)."""
     import jax
     import jax.numpy as jnp
 
     t = ids.shape[0]
     live = jnp.arange(t) < n_rows
     toks0 = jnp.zeros((n_steps, t), jnp.int32)
-    carry0 = (jnp.zeros((), jnp.int32),
-              (ids, positions, kv_len, k_pages, v_pages, k_scales,
-               v_scales, key),
-              toks0, jnp.logical_not(live), jnp.zeros(t, jnp.int32))
+    state0 = (ids, positions, kv_len, k_pages, v_pages, k_scales,
+              v_scales, key)
+    if arch is not None:
+        state0 = state0 + (jnp.zeros(
+            (stack[0].shape[0], arch.num_experts), jnp.int32),)
+    carry0 = (jnp.zeros((), jnp.int32), state0, toks0,
+              jnp.logical_not(live), jnp.zeros(t, jnp.int32))
 
     def cond(carry):
         si, _, _, done, _ = carry
@@ -766,17 +910,18 @@ def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
     def body(carry):
         si, state, toks, done, emitted = carry
         (ids, positions, kv_len, k_pages, v_pages, k_scales, v_scales,
-         key) = state
-        (nxt, k_pages, v_pages, k_scales, v_scales, key) = \
-            _mixed_forward(
-                stack, norm_w, head_w, embed_w, rope,
-                k_pages, v_pages, k_scales, v_scales,
-                ids, positions, row_tables, q_start, q_len, kv_len,
-                desc_tables, desc_of_row, off_of_row, key, draw_base,
-                eps=eps, kvh=kvh, head_dim=head_dim,
-                transpose_head=transpose_head, strategy=strategy,
-                top_k=top_k, top_p=top_p, temperature=temperature,
-                shardings=shardings)
+         key) = state[:8]
+        cacc = state[8] if arch is not None else None
+        res = _mixed_forward(
+            stack, norm_w, head_w, embed_w, rope,
+            k_pages, v_pages, k_scales, v_scales,
+            ids, positions, row_tables, q_start, q_len, kv_len,
+            desc_tables, desc_of_row, off_of_row, key, draw_base,
+            eps=eps, kvh=kvh, head_dim=head_dim,
+            transpose_head=transpose_head, strategy=strategy,
+            top_k=top_k, top_p=top_p, temperature=temperature,
+            shardings=shardings, arch=arch)
+        (nxt, k_pages, v_pages, k_scales, v_scales, key) = res[:6]
         nxt = nxt.astype(jnp.int32)
         toks = jax.lax.dynamic_update_slice(toks, nxt[None], (si, 0))
         fresh = jnp.logical_not(done)
@@ -790,20 +935,25 @@ def _paged_mixed_window(stack, norm_w, head_w, embed_w, rope,
         ids = jnp.where(live, nxt, ids)
         positions = jnp.where(live, positions + 1, positions)
         kv_len = jnp.where(live, kv_len + 1, kv_len)
-        return (si + 1,
-                (ids, positions, kv_len, k_pages, v_pages, k_scales,
-                 v_scales, key),
-                toks, done, emitted)
+        state = (ids, positions, kv_len, k_pages, v_pages, k_scales,
+                 v_scales, key)
+        if arch is not None:
+            state = state + (cacc + res[6],)
+        return (si + 1, state, toks, done, emitted)
 
     si, state, toks, done, emitted = jax.lax.while_loop(
         cond, body, carry0)
-    (_, _, _, k_pages, v_pages, k_scales, v_scales, key) = state
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, key) = state[:8]
+    if arch is None:
+        return (toks, emitted, si, k_pages, v_pages, k_scales,
+                v_scales, key)
     return (toks, emitted, si, k_pages, v_pages, k_scales, v_scales,
-            key)
+            key, state[8])
 
 
 class LLMEngine:
-    """Continuous batching for LlamaForCausalLM-shaped models."""
+    """Continuous batching for backbone-registered models (Llama and
+    Qwen2-MoE/DeepSeekMoE families; see inference/backbone.py)."""
 
     def __init__(self, model, max_seqs: int = 8, max_len: int = 2048,
                  page_size: int = 128, n_pages: Optional[int] = None,
@@ -819,12 +969,19 @@ class LLMEngine:
                  unified_step: bool = True,
                  prefill_token_budget: Optional[int] = None,
                  scan_decode: bool = True,
-                 mesh=None, tp_axis: str = "tp"):
+                 mesh=None, tp_axis: str = "tp",
+                 moe_dispatch: str = "grouped",
+                 moe_dropless: bool = True,
+                 moe_capacity_factor: Optional[float] = None):
+        import math
+
         import jax
         import jax.numpy as jnp
 
         from ..quantization.layers import QuantizedLinear
         from ..quantization.ops import quantize_absmax_raw
+        from .backbone import resolve_backbone
+        from .moe_dispatch import MoEArch
 
         enforce(decode_strategy in ("greedy_search", "sampling"),
                 f"unsupported decode_strategy {decode_strategy!r}")
@@ -834,6 +991,8 @@ class LLMEngine:
                 f"unsupported kv_dtype {kv_dtype!r}")
         enforce(weight_dtype in (None, "int8"),
                 f"unsupported weight_dtype {weight_dtype!r}")
+        enforce(moe_dispatch in ("grouped", "dense"),
+                f"unsupported moe_dispatch {moe_dispatch!r}")
         self.steps_per_sync = steps_per_sync
         # on-device decode windows: steps_per_sync > 1 windows run as
         # ONE compiled while_loop program (attend → sample → KV-append
@@ -873,11 +1032,48 @@ class LLMEngine:
         self.prefix_stats = {"hit_tokens": 0, "miss_tokens": 0,
                              "shared_pages": 0, "hit_requests": 0,
                              "miss_requests": 0}
-        c = model.config
+        # the backbone seam: resolve the model family by duck typing
+        # (llama / qwen2_moe; see inference/backbone.py) instead of
+        # the old hardwired ``model.llama.*`` reads
+        spec = resolve_backbone(model)
+        self._backbone = spec
+        c = spec.config
         self.eps = c.rms_norm_eps
         self.kvh = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
-        layers = model.llama.layers
+        layers = spec.layers
+        # freeze the MoE router geometry into ONE hashable static jit
+        # argument — None keeps every Llama program trace byte
+        # identical to the pre-seam engine
+        self._arch = None
+        if spec.moe is not None:
+            m = spec.moe
+            cf = float(moe_capacity_factor
+                       if moe_capacity_factor is not None
+                       else m["capacity_factor"])
+            # capacity-factor mode: per-page-group per-expert slot cap
+            # (a group = one prefill page chunk of page_size rows;
+            # decode rows are singleton groups and never drop)
+            cap = 0 if moe_dropless else max(
+                int(math.ceil(m["top_k"] * page_size * cf
+                              / m["num_experts"])), 1)
+            self._arch = MoEArch(
+                num_experts=int(m["num_experts"]),
+                top_k=int(m["top_k"]), norm_topk=bool(m["norm_topk"]),
+                capacity=cap, shared=bool(m["shared"]),
+                shared_gate=bool(m["shared_gate"]),
+                attn_bias=bool(spec.attn_bias),
+                dispatch=moe_dispatch)
+            if cap and unified_step:
+                # capacity ranks are defined per page-group, so the
+                # unified planner packs WHOLE page chunks in this
+                # mode — the static budget must fit one
+                enforce(self._pf_budget_static >= page_size,
+                        "capacity-factor MoE with unified_step needs "
+                        f"prefill_token_budget >= page_size "
+                        f"({page_size}) — the planner packs whole "
+                        "page chunks so capacity ranks match the "
+                        "split path")
         # tensor-parallel serving (``mesh=``): attention heads and MLP
         # hidden shard over the ``tp_axis`` of the given 1-D mesh
         # (distributed.topology.serving_mesh builds one); the paged KV
@@ -937,34 +1133,89 @@ class LLMEngine:
                 # per-(layer, out-channel) absmax over the in axis
                 return quantize_absmax_raw(ws, axis=1)
             return ws
-        self._stack = (
-            stackp(lambda l: l.input_layernorm.weight),
-            stackw(lambda l: l.self_attn.q_proj),
-            stackw(lambda l: l.self_attn.k_proj),
-            stackw(lambda l: l.self_attn.v_proj),
-            stackw(lambda l: l.self_attn.o_proj),
-            stackp(lambda l: l.post_attention_layernorm.weight),
-            stackw(lambda l: l.mlp.gate_proj),
-            stackw(lambda l: l.mlp.up_proj),
-            stackw(lambda l: l.mlp.down_proj),
-        )
-        self._norm_w = model.llama.norm.weight.value
+
+        if self._arch is None:
+            self._stack = (
+                stackp(lambda l: l.input_layernorm.weight),
+                stackw(lambda l: l.self_attn.q_proj),
+                stackw(lambda l: l.self_attn.k_proj),
+                stackw(lambda l: l.self_attn.v_proj),
+                stackw(lambda l: l.self_attn.o_proj),
+                stackp(lambda l: l.post_attention_layernorm.weight),
+                stackw(lambda l: l.mlp.gate_proj),
+                stackw(lambda l: l.mlp.up_proj),
+                stackw(lambda l: l.mlp.down_proj),
+            )
+        else:
+            # MoE stack: 17 per-layer entries.  Attention biases and
+            # shared-expert weights that a given config lacks are
+            # stacked as [L, 1, 1] zero placeholders — the static arch
+            # flags skip their use, and the fixed pytree keeps ONE
+            # program signature per geometry.
+            zed = jnp.zeros((len(layers), 1, 1), jnp.float32)
+
+            def stackb(get):
+                bs = [get(l) for l in layers]
+                if bs[0] is None:
+                    enforce(all(b is None for b in bs),
+                            "mixed biased/bias-free attention across "
+                            "decoder layers")
+                    return zed
+                return jnp.stack([b.value for b in bs])
+
+            def stacke(get, axis):
+                """Stack one expert projection [L, E, in, out]; int8
+                quantizes per-(layer, expert, out-channel) over the
+                contraction ``axis``."""
+                ws = jnp.stack([get(l) for l in layers])
+                if weight_dtype == "int8":
+                    return quantize_absmax_raw(ws, axis=axis)
+                return ws
+
+            def stacksh(get):
+                mods = [get(l) for l in layers]
+                if mods[0] is None:
+                    return zed
+                return stackw(lambda l: get(l))
+
+            self._stack = (
+                stackp(lambda l: l.input_layernorm.weight),
+                stackw(lambda l: l.self_attn.q_proj),
+                stackb(lambda l: l.self_attn.q_proj.bias),
+                stackw(lambda l: l.self_attn.k_proj),
+                stackb(lambda l: l.self_attn.k_proj.bias),
+                stackw(lambda l: l.self_attn.v_proj),
+                stackb(lambda l: l.self_attn.v_proj.bias),
+                stackw(lambda l: l.self_attn.o_proj),
+                stackp(lambda l: l.post_attention_layernorm.weight),
+                # router stays fp — its softmax drives routing and is
+                # tiny ([H, E]); expert stacks ride the absmax path
+                stackp(lambda l: l.mlp.gate.weight),
+                stacke(lambda l: l.mlp.experts.gate_w.value, 2),
+                stacke(lambda l: l.mlp.experts.up_w.value, 2),
+                stacke(lambda l: l.mlp.experts.down_w.value, 2),
+                stacksh(lambda l: l.mlp.shared_gate),
+                stacksh(lambda l: getattr(l.mlp, "shared_up", None)),
+                stacksh(lambda l: getattr(l.mlp, "shared_down", None)),
+                stacksh(lambda l: l.mlp.shared_expert_gate),
+            )
+        self._norm_w = spec.norm.weight.value
         # tied embeddings: keep the [V, H] weight and transpose in-graph
         # (an eager .T would hold a duplicate of the full vocab matrix)
-        self._tied = model.lm_head is None
+        self._tied = spec.lm_head is None
         if self._tied:
-            self._head_w = model.llama.embed_tokens.weight.value
-        elif isinstance(model.lm_head, QuantizedLinear):
-            self._head_w = (model.lm_head.qweight.value,
-                            model.lm_head.weight_scale.value)
+            self._head_w = spec.embed_tokens.weight.value
+        elif isinstance(spec.lm_head, QuantizedLinear):
+            self._head_w = (spec.lm_head.qweight.value,
+                            spec.lm_head.weight_scale.value)
         elif weight_dtype == "int8":
             self._head_w = quantize_absmax_raw(
-                model.lm_head.weight.value, axis=0)
+                spec.lm_head.weight.value, axis=0)
         else:
-            self._head_w = model.lm_head.weight.value
-        self._embed_w = model.llama.embed_tokens.weight.value
-        rope = np.asarray(model.llama.rope_cos.value), \
-            np.asarray(model.llama.rope_sin.value)
+            self._head_w = spec.lm_head.weight.value
+        self._embed_w = spec.embed_tokens.weight.value
+        rope = np.asarray(spec.rope_cos.value), \
+            np.asarray(spec.rope_sin.value)
         self._rope = (jnp.asarray(rope[0]), jnp.asarray(rope[1]))
         # the chunked prefill slices a FULL page of rope rows at the
         # last chunk's base; pad the tables to a page multiple so
@@ -1000,11 +1251,22 @@ class LLMEngine:
                     w.shape[dim] % sh.tp == 0 else None
                 return sh.put(w, d)
 
-            # stack order: iln, qw, kw, vw, ow, pln, gw, uw, dw —
-            # layernorm weights (0, 5) replicate, projections shard
-            # on the last (output) axis
+            if self._arch is None:
+                # stack order: iln, qw, kw, vw, ow, pln, gw, uw, dw —
+                # layernorm weights (0, 5) replicate, projections
+                # shard on the last (output) axis
+                rep = (0, 5)
+            else:
+                # MoE stack: layernorms (0, 8) and the whole FFN tail
+                # (router, expert stacks, shared expert; 9..16)
+                # replicate — expert parallelism over the mesh is the
+                # carried ROADMAP item; attention projections and
+                # biases still shard on their output axis (zed
+                # placeholders fall back to replicated via the
+                # divisibility check in _put)
+                rep = (0, 8) + tuple(range(9, 17))
             self._stack = tuple(
-                _put(w, None if i in (0, 5) else -1)
+                _put(w, None if i in rep else -1)
                 for i, w in enumerate(self._stack))
             self._norm_w = _put(self._norm_w, None)
             self._embed_w = _put(self._embed_w, None)
@@ -1019,6 +1281,14 @@ class LLMEngine:
 
         self.requests: Dict[object, GenRequest] = {}
         self._active: List[GenRequest] = []
+        # host-side per-expert load accounting (kept even with metrics
+        # off — metrics_snapshot()/statusz and the bench read it):
+        # routed-slot counts per (layer, expert) plus the running
+        # capacity-drop total (always 0 dropless)
+        if self._arch is not None:
+            self._moe_counts = np.zeros(
+                (len(layers), self._arch.num_experts), np.int64)
+            self._moe_dropped = 0
         self._init_metrics(enable_metrics)
         # compile-watch registration: this engine's three jit entry
         # points and their warmup allowances (the split decode program
@@ -1066,6 +1336,18 @@ class LLMEngine:
             # tp=1 and tp=N streams are bit-identical by construction,
             # so cross-tp replay is allowed — and asserted in tests
             "tp": self._shardings.tp if self._shardings else 1,
+            # TOKEN-AFFECTING router geometry (a tampered router config
+            # must refuse replay); dispatch mode is deliberately
+            # absent — grouped and dense are bit-identical like tp
+            "moe": None if self._arch is None else {
+                "num_experts": self._arch.num_experts,
+                "top_k": self._arch.top_k,
+                "norm_topk": self._arch.norm_topk,
+                "dropless": self._arch.capacity == 0,
+                "capacity": self._arch.capacity,
+                "shared": self._arch.shared,
+                "shared_gate": self._arch.shared_gate,
+            },
         }
 
     def config_fingerprint(self) -> dict:
@@ -1164,6 +1446,26 @@ class LLMEngine:
                 "mixed step (interleave ratio = this / (this + decode "
                 "slots)).", lbl).labels(eid),
         }
+        if self._arch is not None:
+            # MoE serving observability: the per-(layer, expert) load
+            # counter family plus the imbalance SLO gauge (max/mean
+            # per-expert load over all layers — 1.0 is perfect
+            # balance, E means one expert takes everything)
+            self._metrics["expert_tokens"] = reg.counter(
+                "llm_engine_expert_tokens_total",
+                "Routed token-slots kept per (layer, expert) — "
+                "capacity-dropped slots are excluded (see "
+                "llm_engine_expert_dropped_tokens_total).",
+                ("engine", "layer", "expert"))
+            self._metrics["expert_dropped"] = reg.counter(
+                "llm_engine_expert_dropped_tokens_total",
+                "Routed token-slots dropped by the capacity factor "
+                "(always 0 dropless).", lbl).labels(eid)
+            self._metrics["expert_imbalance"] = reg.gauge(
+                "llm_engine_expert_imbalance",
+                "Max/mean cumulative per-expert routed load across "
+                "layers (the MoE balance SLO; 1.0 = uniform).",
+                lbl).labels(eid)
         # compile-count gauges are process-global (the jit caches are),
         # unlabeled: any drift past 1 means a recompile regression —
         # alarm on it instead of diagnosing a silent latency cliff
@@ -1192,6 +1494,32 @@ class LLMEngine:
         m["mixed_compiles"].set(self.mixed_compiles())
         m["window_compiles"].set(self.window_compiles())
 
+    def _note_expert_counts(self, counts, routed_slots: int):
+        """Fold one MoE dispatch's routed-token counts ([L, E] device
+        int32) into the host accounting and the registry.
+        ``routed_slots`` is the number of live (row, top-k) slots the
+        dispatch routed PER LAYER — kept + capacity-dropped — so the
+        drop total is ``routed_slots·L − counts.sum()`` (identically 0
+        dropless).  One device_get per dispatch WINDOW, never per
+        token, same budget discipline as the latency metrics."""
+        import jax
+
+        cnt = np.asarray(jax.device_get(counts), np.int64)
+        self._moe_counts += cnt
+        dropped = int(routed_slots) * cnt.shape[0] - int(cnt.sum())
+        self._moe_dropped += dropped
+        if self._metrics is not None:
+            fam = self._metrics["expert_tokens"]
+            eid = self.engine_id
+            for l, e in zip(*np.nonzero(cnt)):
+                fam.labels(eid, str(l), str(e)).inc(int(cnt[l, e]))
+            if dropped:
+                self._metrics["expert_dropped"].inc(dropped)
+            tot = self._moe_counts.sum(axis=0).astype(np.float64)
+            if tot.sum() > 0:
+                self._metrics["expert_imbalance"].set(
+                    float(tot.max() / tot.mean()))
+
     # -- prefill / replay internals --------------------------------------------
     def _prefill_seq(self, slot, seq, start_chunk: int):
         """Run the single compiled chunked-prefill program over
@@ -1217,22 +1545,26 @@ class LLMEngine:
             # and the shared NULL_SPAN when tracing is off
             chunk_span = _tracing.span("engine.prefill_chunk")
             chunk_span.set_attr("chunk", ci).set_attr("tokens", real)
+            out = _insp.watched_call(
+                "engine.prefill_chunk", _paged_prefill_chunk,
+                self._stack, self._norm_w, self._head_w,
+                self._embed_w, self._rope_prefill,
+                self.cache.k_pages, self.cache.v_pages,
+                self.cache.k_scales, self.cache.v_scales,
+                jnp.asarray(chunk),
+                jnp.asarray(table), jnp.int32(base),
+                jnp.int32(int(table[ci])),
+                jnp.int32(min(plen - 1 - base, P - 1)),
+                eps=self.eps, kvh=self.kvh,
+                head_dim=self.head_dim,
+                transpose_head=self._tied,
+                shardings=self._shardings, arch=self._arch)
+            if self._arch is not None:
+                self._note_expert_counts(
+                    out[-1], real * self._arch.top_k)
+                out = out[:-1]
             (logits, self.cache.k_pages, self.cache.v_pages,
-             self.cache.k_scales, self.cache.v_scales) = \
-                _insp.watched_call(
-                    "engine.prefill_chunk", _paged_prefill_chunk,
-                    self._stack, self._norm_w, self._head_w,
-                    self._embed_w, self._rope_prefill,
-                    self.cache.k_pages, self.cache.v_pages,
-                    self.cache.k_scales, self.cache.v_scales,
-                    jnp.asarray(chunk),
-                    jnp.asarray(table), jnp.int32(base),
-                    jnp.int32(int(table[ci])),
-                    jnp.int32(min(plen - 1 - base, P - 1)),
-                    eps=self.eps, kvh=self.kvh,
-                    head_dim=self.head_dim,
-                    transpose_head=self._tied,
-                    shardings=self._shardings)
+             self.cache.k_scales, self.cache.v_scales) = out
             chunk_span.end()
         return logits
 
@@ -1266,23 +1598,27 @@ class LLMEngine:
                                    np.zeros(pad, np.int32)])
             tables = np.concatenate(
                 [self.cache.page_table[[slot]], padt])
+            out = _insp.watched_call(
+                "engine.decode_step", _paged_decode_step,
+                self._stack, self._norm_w, self._head_w,
+                self._embed_w, self._rope, self.cache.k_pages,
+                self.cache.v_pages, self.cache.k_scales,
+                self.cache.v_scales, jnp.asarray(tokens),
+                jnp.asarray(lens, np.int32), jnp.asarray(tables),
+                jnp.asarray(lens, np.int32), key, jnp.int32(0),
+                eps=self.eps, kvh=self.kvh,
+                head_dim=self.head_dim,
+                transpose_head=self._tied,
+                strategy=self.decode_strategy,
+                top_k=self.top_k, top_p=self.top_p,
+                temperature=self.temperature, n_steps=nsteps,
+                shardings=self._shardings, arch=self._arch)
+            if self._arch is not None:
+                self._note_expert_counts(
+                    out[-1], self._arch.top_k * nsteps)
+                out = out[:-1]
             (_, self.cache.k_pages, self.cache.v_pages,
-             self.cache.k_scales, self.cache.v_scales) = \
-                _insp.watched_call(
-                    "engine.decode_step", _paged_decode_step,
-                    self._stack, self._norm_w, self._head_w,
-                    self._embed_w, self._rope, self.cache.k_pages,
-                    self.cache.v_pages, self.cache.k_scales,
-                    self.cache.v_scales, jnp.asarray(tokens),
-                    jnp.asarray(lens, np.int32), jnp.asarray(tables),
-                    jnp.asarray(lens, np.int32), key, jnp.int32(0),
-                    eps=self.eps, kvh=self.kvh,
-                    head_dim=self.head_dim,
-                    transpose_head=self._tied,
-                    strategy=self.decode_strategy,
-                    top_k=self.top_k, top_p=self.top_p,
-                    temperature=self.temperature, n_steps=nsteps,
-                    shardings=self._shardings)
+             self.cache.k_scales, self.cache.v_scales) = out
             self.cache.advance([slot], nsteps)
             i += nsteps
 
@@ -1552,49 +1888,55 @@ class LLMEngine:
                     if r.eos is not None:
                         eos_ids[i] = r.eos
                     budgets[i] = r.max_new - len(r.out)
+                res = _insp.watched_call(
+                    "engine.decode_window", _paged_decode_window,
+                    self._stack, self._norm_w, self._head_w,
+                    self._embed_w, self._rope, self.cache.k_pages,
+                    self.cache.v_pages, self.cache.k_scales,
+                    self.cache.v_scales, jnp.asarray(tokens),
+                    jnp.asarray(lens, np.int32),
+                    jnp.asarray(tables),
+                    jnp.asarray(lens, np.int32), sub,
+                    jnp.int32(0),
+                    jnp.asarray(eos_ids), jnp.asarray(budgets),
+                    jnp.int32(n),
+                    eps=self.eps, kvh=self.kvh,
+                    head_dim=self.head_dim,
+                    transpose_head=self._tied,
+                    strategy=self.decode_strategy,
+                    top_k=self.top_k, top_p=self.top_p,
+                    temperature=self.temperature, n_steps=nsteps,
+                    shardings=self._shardings, arch=self._arch)
                 (toks, _, steps_d, self.cache.k_pages,
                  self.cache.v_pages, self.cache.k_scales,
-                 self.cache.v_scales) = \
-                    _insp.watched_call(
-                        "engine.decode_window", _paged_decode_window,
-                        self._stack, self._norm_w, self._head_w,
-                        self._embed_w, self._rope, self.cache.k_pages,
-                        self.cache.v_pages, self.cache.k_scales,
-                        self.cache.v_scales, jnp.asarray(tokens),
-                        jnp.asarray(lens, np.int32),
-                        jnp.asarray(tables),
-                        jnp.asarray(lens, np.int32), sub,
-                        jnp.int32(0),
-                        jnp.asarray(eos_ids), jnp.asarray(budgets),
-                        jnp.int32(n),
-                        eps=self.eps, kvh=self.kvh,
-                        head_dim=self.head_dim,
-                        transpose_head=self._tied,
-                        strategy=self.decode_strategy,
-                        top_k=self.top_k, top_p=self.top_p,
-                        temperature=self.temperature, n_steps=nsteps,
-                        shardings=self._shardings)
+                 self.cache.v_scales) = res[:7]
                 steps_done = int(jax.device_get(steps_d))
+                if self._arch is not None:
+                    self._note_expert_counts(
+                        res[7], n * self._arch.top_k * steps_done)
             else:
+                res = _insp.watched_call(
+                    "engine.decode_step", _paged_decode_step,
+                    self._stack, self._norm_w, self._head_w,
+                    self._embed_w, self._rope, self.cache.k_pages,
+                    self.cache.v_pages, self.cache.k_scales,
+                    self.cache.v_scales, jnp.asarray(tokens),
+                    jnp.asarray(lens, np.int32),
+                    jnp.asarray(tables),
+                    jnp.asarray(lens, np.int32), sub,
+                    jnp.int32(0),
+                    eps=self.eps, kvh=self.kvh,
+                    head_dim=self.head_dim,
+                    transpose_head=self._tied,
+                    strategy=self.decode_strategy,
+                    top_k=self.top_k, top_p=self.top_p,
+                    temperature=self.temperature, n_steps=nsteps,
+                    shardings=self._shardings, arch=self._arch)
                 (toks, self.cache.k_pages, self.cache.v_pages,
-                 self.cache.k_scales, self.cache.v_scales) = \
-                    _insp.watched_call(
-                        "engine.decode_step", _paged_decode_step,
-                        self._stack, self._norm_w, self._head_w,
-                        self._embed_w, self._rope, self.cache.k_pages,
-                        self.cache.v_pages, self.cache.k_scales,
-                        self.cache.v_scales, jnp.asarray(tokens),
-                        jnp.asarray(lens, np.int32),
-                        jnp.asarray(tables),
-                        jnp.asarray(lens, np.int32), sub,
-                        jnp.int32(0),
-                        eps=self.eps, kvh=self.kvh,
-                        head_dim=self.head_dim,
-                        transpose_head=self._tied,
-                        strategy=self.decode_strategy,
-                        top_k=self.top_k, top_p=self.top_p,
-                        temperature=self.temperature, n_steps=nsteps,
-                        shardings=self._shardings)
+                 self.cache.k_scales, self.cache.v_scales) = res[:5]
+                if self._arch is not None:
+                    self._note_expert_counts(
+                        res[5], n * self._arch.top_k * nsteps)
                 steps_done = nsteps
             self.cache.advance(slots, steps_done)
             # [steps_done, n]
@@ -1687,14 +2029,28 @@ class LLMEngine:
                             self._pf_budget_static))
         if not batch and budget == 0:
             budget = min(P, self._pf_budget_static)
+        # capacity-factor MoE defines its drop ranks per page-group =
+        # page chunk, so the planner must pack WHOLE chunks (a split
+        # chunk would rank differently than the split prefill path);
+        # floor the runtime budget to one chunk when only prefill is
+        # pending so a low budget can't livelock has_work()
+        whole_chunks = self._arch is not None and \
+            self._arch.capacity > 0
+        if whole_chunks and not batch:
+            budget = max(budget, min(P, self._pf_budget_static))
         plan = []
         finishing = []                        # (req, last_row)
         cursor, desc_i, used = n, n, 0
+        stop = False
         for req in self._prefilling:
             plen = len(req.prompt)
             pos = req.pf_pos
             while pos < plen and used < budget:
-                cl = min(P - pos % P, plen - pos, budget - used)
+                chunk = min(P - pos % P, plen - pos)
+                if whole_chunks and used + chunk > budget:
+                    stop = True
+                    break
+                cl = min(chunk, budget - used)
                 plan.append((req, pos, cl, cursor, desc_i))
                 pos += cl
                 cursor += cl
@@ -1702,7 +2058,7 @@ class LLMEngine:
                 desc_i += 1
             if pos >= plen:
                 finishing.append((req, cursor - 1))
-            if used >= budget:
+            if stop or used >= budget:
                 break
         if not batch and not plan:
             return {}
@@ -1774,68 +2130,82 @@ class LLMEngine:
                         if r.eos is not None:
                             eos_ids[i] = r.eos
                         budgets[i] = r.max_new - len(r.out)
+                    res = _insp.watched_call(
+                        "engine.mixed_window", _paged_mixed_window,
+                        self._stack, self._norm_w, self._head_w,
+                        self._embed_w, self._rope,
+                        self.cache.k_pages, self.cache.v_pages,
+                        self.cache.k_scales, self.cache.v_scales,
+                        jnp.asarray(ids), jnp.asarray(positions),
+                        jnp.asarray(row_tables),
+                        jnp.asarray(q_start), jnp.asarray(q_len),
+                        jnp.asarray(kv_len),
+                        jnp.asarray(desc_tables),
+                        jnp.asarray(desc_of_row),
+                        jnp.asarray(off_of_row), key,
+                        jnp.int32(0),
+                        jnp.asarray(eos_ids),
+                        jnp.asarray(budgets), jnp.int32(n),
+                        eps=self.eps, kvh=self.kvh,
+                        head_dim=self.head_dim,
+                        transpose_head=self._tied,
+                        strategy=self.decode_strategy,
+                        top_k=self.top_k, top_p=self.top_p,
+                        temperature=self.temperature,
+                        n_steps=nsteps,
+                        shardings=self._shardings, arch=self._arch)
                     (toks_d, _, steps_d, self.cache.k_pages,
                      self.cache.v_pages, self.cache.k_scales,
-                     self.cache.v_scales, key) = \
-                        _insp.watched_call(
-                            "engine.mixed_window", _paged_mixed_window,
-                            self._stack, self._norm_w, self._head_w,
-                            self._embed_w, self._rope,
-                            self.cache.k_pages, self.cache.v_pages,
-                            self.cache.k_scales, self.cache.v_scales,
-                            jnp.asarray(ids), jnp.asarray(positions),
-                            jnp.asarray(row_tables),
-                            jnp.asarray(q_start), jnp.asarray(q_len),
-                            jnp.asarray(kv_len),
-                            jnp.asarray(desc_tables),
-                            jnp.asarray(desc_of_row),
-                            jnp.asarray(off_of_row), key,
-                            jnp.int32(0),
-                            jnp.asarray(eos_ids),
-                            jnp.asarray(budgets), jnp.int32(n),
-                            eps=self.eps, kvh=self.kvh,
-                            head_dim=self.head_dim,
-                            transpose_head=self._tied,
-                            strategy=self.decode_strategy,
-                            top_k=self.top_k, top_p=self.top_p,
-                            temperature=self.temperature,
-                            n_steps=nsteps,
-                            shardings=self._shardings)
+                     self.cache.v_scales, key) = res[:8]
                     steps_done = int(jax.device_get(steps_d))
+                    if self._arch is not None:
+                        self._note_expert_counts(
+                            res[8],
+                            n * self._arch.top_k * steps_done)
                     toks_np = np.asarray(jax.device_get(toks_d))
                     toks_all = [toks_np[j] for j in range(steps_done)]
                     if n:
                         self.cache.advance(slots, steps_done)
                 else:
                     for si in range(nsteps):
+                        res = _insp.watched_call(
+                            "engine.mixed_step", _paged_mixed_step,
+                            self._stack, self._norm_w,
+                            self._head_w, self._embed_w,
+                            self._rope,
+                            self.cache.k_pages, self.cache.v_pages,
+                            self.cache.k_scales,
+                            self.cache.v_scales,
+                            jnp.asarray(ids),
+                            jnp.asarray(positions),
+                            jnp.asarray(row_tables),
+                            jnp.asarray(q_start),
+                            jnp.asarray(q_len),
+                            jnp.asarray(kv_len),
+                            jnp.asarray(desc_tables),
+                            jnp.asarray(desc_of_row),
+                            jnp.asarray(off_of_row), key,
+                            jnp.int32(0),
+                            eps=self.eps, kvh=self.kvh,
+                            head_dim=self.head_dim,
+                            transpose_head=self._tied,
+                            strategy=self.decode_strategy,
+                            top_k=self.top_k, top_p=self.top_p,
+                            temperature=self.temperature,
+                            shardings=self._shardings,
+                            arch=self._arch)
                         (nxt, self.cache.k_pages, self.cache.v_pages,
                          self.cache.k_scales, self.cache.v_scales,
-                         key) = \
-                            _insp.watched_call(
-                                "engine.mixed_step", _paged_mixed_step,
-                                self._stack, self._norm_w,
-                                self._head_w, self._embed_w,
-                                self._rope,
-                                self.cache.k_pages, self.cache.v_pages,
-                                self.cache.k_scales,
-                                self.cache.v_scales,
-                                jnp.asarray(ids),
-                                jnp.asarray(positions),
-                                jnp.asarray(row_tables),
-                                jnp.asarray(q_start),
-                                jnp.asarray(q_len),
-                                jnp.asarray(kv_len),
-                                jnp.asarray(desc_tables),
-                                jnp.asarray(desc_of_row),
-                                jnp.asarray(off_of_row), key,
-                                jnp.int32(0),
-                                eps=self.eps, kvh=self.kvh,
-                                head_dim=self.head_dim,
-                                transpose_head=self._tied,
-                                strategy=self.decode_strategy,
-                                top_k=self.top_k, top_p=self.top_p,
-                                temperature=self.temperature,
-                                shardings=self._shardings)
+                         key) = res[:6]
+                        if self._arch is not None:
+                            # live rows this dispatch: n decode slots
+                            # + the packed prefill tokens (used == 0
+                            # past the first step — multi-step windows
+                            # are pure decode)
+                            self._note_expert_counts(
+                                res[6],
+                                (n + (used if si == 0 else 0))
+                                * self._arch.top_k)
                         nxt = np.asarray(jax.device_get(nxt))
                         toks_all.append(nxt)
                         if n:
@@ -2336,6 +2706,24 @@ class LLMEngine:
                 hit_rate=(self.prefix_stats["hit_tokens"] / seen
                           if seen else 0.0)),
         }
+        if self._arch is not None:
+            # per-expert load plane (host counters — present with
+            # metrics off too, like the prefix stats): cumulative
+            # routed slots summed over layers, the capacity-drop
+            # total, and the max/mean imbalance SLO
+            tot = self._moe_counts.sum(axis=0)
+            snap["moe"] = {
+                "num_experts": self._arch.num_experts,
+                "top_k": self._arch.top_k,
+                "dropless": self._arch.capacity == 0,
+                "capacity": self._arch.capacity,
+                "dispatch": self._arch.dispatch,
+                "shared_experts": self._arch.shared,
+                "expert_tokens": [int(v) for v in tot],
+                "dropped_tokens": int(self._moe_dropped),
+                "imbalance": (float(tot.max() / tot.mean())
+                              if tot.sum() else 0.0),
+            }
         if self._metrics is not None:
             m = self._metrics
             snap.update({

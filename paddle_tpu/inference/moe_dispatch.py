@@ -1,0 +1,219 @@
+"""MoE FFN step path for LLMEngine serving (ISSUE: ROADMAP item 3).
+
+One traced function, :func:`moe_ffn`, replaces the dense SwiGLU FFN
+inside every serving program's decoder-layer body when the engine's
+backbone is an MoE family (Qwen2-MoE/DeepSeekMoE geometry): top-k
+router → token→expert dispatch → per-expert SwiGLU → top-k combine,
+plus the always-on shared expert.  All routing tensors are TRACED data
+— descriptors never surface to the host — so the engine's one-compile
+invariants (``mixed_compiles() == 1`` per geometry) survive untouched.
+
+The static part of the configuration is ONE hashable :class:`MoEArch`
+jit argument; everything else (which tokens, which experts) is data.
+
+Two dispatch modes, BIT-IDENTICAL on CPU by construction:
+
+- ``grouped`` — the production shape: sort routed slots by expert into
+  the tile-aligned dropless layout (ops/pallas/grouped_matmul.py's
+  ``make_dropless_plan_rows``) and run ONE grouped matmul per
+  projection per layer (no per-expert programs).  On TPU the Pallas
+  ``gmm`` kernels do the work; on CPU the per-row gathered-einsum
+  oracle (``gmm_reference``'s idiom) does — which is exactly the
+  row-wise math the dense mode runs, so the two modes agree bit for
+  bit off-TPU (each row's contraction is independent of every other
+  row's placement).
+- ``dense`` — the per-row reference: gather each slot's expert weights
+  and contract row-wise, no sorting.  The A/B comparator for tests and
+  the bench's per-expert-loop baseline.
+
+Token dropping: ``arch.capacity == 0`` is dropless (every routed slot
+computes).  ``capacity > 0`` is the capacity-factor mode: within each
+page-group (a prefill chunk; decode rows are singleton groups and can
+never drop, since ``jax.lax.top_k`` returns distinct experts), an
+expert keeps at most ``capacity`` slots in slot order and the rest
+contribute exactly +0.0 to the combine — deterministic across the
+split/unified/scanned paths because the group boundaries are page
+chunks on every path (the unified planner packs whole page chunks in
+capacity mode).
+
+INT8 expert weights ride the quantization absmax path: stacks arrive
+as ``(int8 values, f32 scale)`` pairs with per-(expert, out-channel)
+scales that multiply the contraction OUTPUT — same fold the engine's
+``_mm`` uses — so both dispatch modes stay bit-identical quantized.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+__all__ = ["MoEArch", "moe_ffn"]
+
+
+class MoEArch(NamedTuple):
+    """Hashable static-jit MoE dispatch configuration.  ``capacity`` is
+    the per-page-group per-expert slot cap (0 = dropless); ``dispatch``
+    is ``"grouped"`` or ``"dense"`` (bit-identical on CPU — excluded
+    from the capsule fingerprint like tp)."""
+    num_experts: int
+    top_k: int
+    norm_topk: bool
+    capacity: int
+    shared: bool
+    shared_gate: bool
+    attn_bias: bool
+    dispatch: str
+
+
+def _mm(x, w):
+    """x @ w for fp or weight-only-int8 (values, per-out-channel scale)
+    stacked weights — the engine's fold, restated here to avoid a
+    circular import."""
+    import jax.numpy as jnp
+    if isinstance(w, tuple):
+        qw, sc = w
+        return jnp.matmul(x, qw.astype(x.dtype)) * sc.astype(x.dtype)
+    return jnp.matmul(x, w)
+
+
+def _expert_rows_mm(x, w, row_expert):
+    """Row-wise expert contraction: row i of ``x`` [M, K] against
+    ``w[row_expert[i]]`` ([E, K, N] or int8 pair), f32 accumulate.
+    Each output row depends only on its own inputs — row-order
+    independent bitwise, which is the whole grouped≡dense argument."""
+    import jax.numpy as jnp
+    if isinstance(w, tuple):
+        qw, sc = w
+        wr = qw[row_expert]
+        y = jnp.einsum("mk,mkn->mn", x.astype(jnp.float32),
+                       wr.astype(jnp.float32))
+        return y * sc[row_expert]
+    wr = w[row_expert]
+    return jnp.einsum("mk,mkn->mn", x.astype(jnp.float32),
+                      wr.astype(jnp.float32))
+
+
+def _gmm_apply(xs, w, tile_expert, gcounts, tm, on_tpu):
+    """One grouped matmul over the sorted tile-aligned buffer: the
+    Pallas kernel on TPU, the per-row oracle (same rows, same math as
+    dense mode) on CPU."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas.grouped_matmul import gmm, gmm_reference
+    if not on_tpu:
+        row_e = jnp.repeat(tile_expert, tm)
+        return _expert_rows_mm(xs, w, row_e)
+    if isinstance(w, tuple):
+        # the kernel streams one weight dtype; upcast feeds the MXU
+        # copy XLA fuses into the kernel's input stream, and the
+        # per-out-channel scale folds into the output like _mm's
+        qw, sc = w
+        y = gmm(xs, qw.astype(xs.dtype), tile_expert, gcounts, tm=tm)
+        return y * sc[jnp.repeat(tile_expert, tm)]
+    return gmm(xs, w, tile_expert, gcounts, tm=tm)
+
+
+def moe_ffn(hn, mw, arch, live, group_start=None):
+    """The MoE decoder-layer FFN for one serving dispatch.
+
+    hn [T, H] post-attention-layernorm rows; ``mw`` the per-layer
+    weight tuple ``(rw, egw, euw, edw, sgw, suw, sdw, seg)`` (router
+    [H, E] fp; expert stacks [E, H, F]/[E, F, H], fp or int8 pairs;
+    shared-expert Linears, placeholder [1, 1] zeros when
+    ``arch.shared`` is off); ``live`` [T] bool masks padding rows out
+    of routing (their FFN output is unread); ``group_start`` [T] int32
+    maps each row to its capacity page-group's first row (``None`` =
+    every row its own group — the decode programs, where top-k's
+    distinct experts make the in-group rank identically 0).
+
+    Returns ``(ffn_out [T, H], counts [E] int32)`` — counts are the
+    KEPT routed slots per expert (the observability plane's per-expert
+    load; dropless ⇒ sum == live·k)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas.grouped_matmul import (_auto_tm,
+                                             make_dropless_plan_rows)
+    from ..runtime.device import is_compiled_with_tpu
+
+    rw, egw, euw, edw, sgw, suw, sdw, seg = mw
+    t, h = hn.shape
+    e, k = arch.num_experts, arch.top_k
+    f32 = jnp.float32
+    xf = hn.astype(f32)
+
+    # router (nn/moe.py _router_parts math, serving subset): softmax
+    # over ALL experts, then top-k; HF Qwen2-MoE ships norm_topk off
+    logits = jnp.dot(xf, rw.astype(f32))
+    probs = jax.nn.softmax(logits, axis=-1)                 # [T, E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)         # [T, k]
+    if arch.norm_topk:
+        gate_vals = gate_vals / jnp.clip(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    live_slot = jnp.repeat(live, k)                         # [T*k]
+    eidx = expert_idx.reshape(-1)
+    if arch.capacity and group_start is not None:
+        # in-group rank of each slot = live same-expert slots before it
+        # within its page group, via ONE exclusive cumsum over the flat
+        # slot order minus the value at the group's first slot (slots
+        # before the group cancel, so groups never contaminate each
+        # other — the split-prefill chunk and the unified planner's
+        # whole-page chunk rank identically)
+        onehot = (jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
+                  * live[:, None, None].astype(jnp.int32)
+                  ).reshape(t * k, e)
+        ex_cum = jnp.cumsum(onehot, axis=0) - onehot        # exclusive
+        first_slot = jnp.repeat(group_start, k) * k
+        base = jnp.take(ex_cum, first_slot, axis=0)
+        rank = jnp.take_along_axis(ex_cum - base,
+                                   eidx[:, None], axis=1)[:, 0]
+        keep = live_slot & (rank < arch.capacity)
+    else:
+        # dropless — or decode rows (singleton groups): top_k returns
+        # distinct experts, so every in-group rank is 0 < capacity
+        keep = live_slot
+    row_expert = jnp.where(keep, eidx, e)                   # e = dropped
+    counts = jnp.sum(
+        jax.nn.one_hot(eidx, e, dtype=jnp.int32)
+        * keep[:, None].astype(jnp.int32), axis=0)          # [E]
+
+    if arch.dispatch == "grouped":
+        on_tpu = is_compiled_with_tpu()
+        tm = _auto_tm(e, t * k) if on_tpu else 8
+        order, dest, valid_sorted, tile_expert, gcounts, m_pad = \
+            make_dropless_plan_rows(row_expert, e, tm)
+        xs = jnp.zeros((m_pad, h), f32).at[dest].set(
+            xf[order // k], mode="drop")
+        hg = _gmm_apply(xs, egw, tile_expert, gcounts, tm, on_tpu)
+        hu = _gmm_apply(xs, euw, tile_expert, gcounts, tm, on_tpu)
+        hs = (jax.nn.silu(hg.astype(f32))
+              * hu.astype(f32)).astype(xs.dtype)
+        ys = _gmm_apply(hs, edw, tile_expert, gcounts, tm, on_tpu)
+        dest_safe = jnp.minimum(dest, m_pad - 1)
+        y_sorted = jnp.where(valid_sorted[:, None],
+                             ys[dest_safe].astype(f32), 0.0)
+        y = jnp.zeros((t * k, h), f32).at[order].set(y_sorted)
+    else:
+        # dense per-expert reference: the same row-wise contractions
+        # on the unsorted slot rows, dropped slots zeroed after
+        safe = jnp.minimum(eidx, e - 1)
+        xdup = jnp.repeat(xf, k, axis=0)                    # [T*k, H]
+        hg = _expert_rows_mm(xdup, egw, safe)
+        hu = _expert_rows_mm(xdup, euw, safe)
+        hs = (jax.nn.silu(hg.astype(f32))
+              * hu.astype(f32)).astype(xdup.dtype)
+        ys = _expert_rows_mm(hs, edw, safe)
+        y = jnp.where(keep[:, None], ys.astype(f32), 0.0)
+
+    out = jnp.einsum("tk,tkh->th", gate_vals.astype(f32),
+                     y.reshape(t, k, h))                    # [T, H]
+
+    if arch.shared:
+        # shared-expert SwiGLU (+ optional sigmoid token gate) — the
+        # Qwen2-MoE composition (nn/moe.py MoELayer)
+        sh = jax.nn.silu(_mm(xf, sgw)) * _mm(xf, suw)
+        shared = _mm(sh, sdw)
+        if arch.shared_gate:
+            shared = shared * jax.nn.sigmoid(_mm(xf, seg))
+        out = out + shared
+
+    return out.astype(hn.dtype), counts

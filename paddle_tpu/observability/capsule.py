@@ -80,6 +80,11 @@ __all__ = [
 _TOKEN_AFFECTING = (
     "model_hash", "kv_dtype", "weight_dtype", "page_size",
     "decode_strategy", "top_k", "top_p", "temperature",
+    # MoE router geometry (num_experts/k/norm_topk/capacity/shared):
+    # a tampered router config routes differently and must refuse
+    # replay.  The dispatch MODE (grouped vs dense) is deliberately
+    # inside neither — the two are bit-identical, like tp.
+    "moe",
 )
 
 
@@ -615,27 +620,31 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
                                    np.zeros(pad, np.int32)])
             tables = np.concatenate(
                 [engine.cache.page_table[[slot]], padt])
+            res = _insp.watched_call(
+                "engine.decode_step", _eng._paged_decode_step,
+                engine._stack, engine._norm_w, engine._head_w,
+                engine._embed_w, engine._rope,
+                engine.cache.k_pages, engine.cache.v_pages,
+                engine.cache.k_scales, engine.cache.v_scales,
+                jnp.asarray(tokens), jnp.asarray(lens, np.int32),
+                jnp.asarray(tables), jnp.asarray(lens, np.int32),
+                key, jnp.int32(draw_row),
+                eps=engine.eps, kvh=engine.kvh,
+                head_dim=engine.head_dim,
+                transpose_head=engine._tied,
+                strategy=strategy,
+                top_k=fp.get("top_k", engine.top_k),
+                top_p=fp.get("top_p", engine.top_p),
+                temperature=fp.get("temperature",
+                                   engine.temperature),
+                n_steps=n_steps,
+                shardings=engine._shardings,
+                arch=getattr(engine, "_arch", None))
+            # MoE engines return a trailing expert-counts array; the
+            # replay compares tokens only and never feeds the live
+            # load metrics (a replay is not traffic)
             (toks, engine.cache.k_pages, engine.cache.v_pages,
-             engine.cache.k_scales, engine.cache.v_scales) = \
-                _insp.watched_call(
-                    "engine.decode_step", _eng._paged_decode_step,
-                    engine._stack, engine._norm_w, engine._head_w,
-                    engine._embed_w, engine._rope,
-                    engine.cache.k_pages, engine.cache.v_pages,
-                    engine.cache.k_scales, engine.cache.v_scales,
-                    jnp.asarray(tokens), jnp.asarray(lens, np.int32),
-                    jnp.asarray(tables), jnp.asarray(lens, np.int32),
-                    key, jnp.int32(draw_row),
-                    eps=engine.eps, kvh=engine.kvh,
-                    head_dim=engine.head_dim,
-                    transpose_head=engine._tied,
-                    strategy=strategy,
-                    top_k=fp.get("top_k", engine.top_k),
-                    top_p=fp.get("top_p", engine.top_p),
-                    temperature=fp.get("temperature",
-                                       engine.temperature),
-                    n_steps=n_steps,
-                    shardings=engine._shardings)
+             engine.cache.k_scales, engine.cache.v_scales) = res[:5]
             got = np.asarray(jax.device_get(toks))[:, 0]
             for j in range(take):
                 report["steps_compared"] += 1

@@ -757,6 +757,28 @@ class ReplicaRouter:
                 for key in ("captured_total", "persisted_total",
                             "live", "replays_total",
                             "divergent_replays_total")}
+        # MoE-plane federation: element-wise per-expert load sum and
+        # the fleet-wide imbalance recomputed from the merged loads (a
+        # mean of per-replica ratios would hide one replica's hot
+        # expert behind another's cold one)
+        moes = [(s.get("engine") or {}).get("moe") for s in fresh]
+        moes = [m for m in moes if m]
+        if moes:
+            width = max(len(m.get("expert_tokens") or []) for m in moes)
+            tok = [0] * width
+            for m in moes:
+                for i, v in enumerate(m.get("expert_tokens") or []):
+                    tok[i] += int(v)
+            total = sum(tok)
+            fleet["moe"] = {
+                "num_experts": width,
+                "expert_tokens": tok,
+                "dropped_tokens": sum(
+                    int(m.get("dropped_tokens", 0) or 0)
+                    for m in moes),
+                "imbalance": (max(tok) / (total / width)
+                              if total else 0.0),
+            }
         out = {"router": self.router_id, "retries": self.retry_count,
                "ejected": sorted(self._ejected),
                "replicas": rows, "fleet": fleet}

@@ -418,6 +418,11 @@ class HTTPFrontend:
             "mixed_batch_prefill_tokens":
                 eng.get("mixed_batch_prefill_tokens"),
             "mixed_compiles": eng.get("mixed_compiles"),
+            # MoE serving: per-expert load + imbalance SLO (None for
+            # dense-FFN backbones; scheduler targets nest the engine
+            # snapshot, router targets federate via /fleetz)
+            "moe": eng.get("moe") if isinstance(eng, dict)
+            else snap.get("moe"),
             "ttft_seconds": self._ttft_view(eng),
         }
         tr = _tracing.get_tracer()
