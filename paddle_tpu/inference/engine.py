@@ -127,6 +127,10 @@ _TTFT_BUCKETS = (.01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0,
                  30.0, 60.0)
 _TPOT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
                  .5, 1.0)
+# accepted-draft-length ladder (speculative windows): covers k up to
+# 32; fixed so engines with different spec_k share the family
+_SPEC_LEN_BUCKETS = (0., 1., 2., 3., 4., 5., 6., 7., 8., 12., 16.,
+                     24., 32.)
 
 
 class GenRequest:
@@ -147,6 +151,10 @@ class GenRequest:
         # position to prefill, and the submit time TTFT measures from
         self.pf_pos = 0
         self.t_submit: Optional[float] = None
+        # speculative decoding: the request's DRAFT KV slot in the
+        # engine's second paged cache — attached lazily at its first
+        # speculative window, released on retire/suspend/abort
+        self.draft_slot: Optional[int] = None
 
 
 def _wout(w) -> int:
@@ -377,7 +385,7 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                          *, eps, kvh, head_dim, transpose_head,
                          strategy, top_k, top_p, temperature,
                          draw_base=None, shardings=None, arch=None,
-                         live=None):
+                         live=None, collect_probs=False):
     """Build the one-token decode body shared by ``_paged_decode_step``
     (fixed-length window) and ``_paged_decode_window`` (the early-exit
     scanned window).  ONE definition of the per-step math — embed,
@@ -390,6 +398,10 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
     CAPTURED row so a request replayed in row 0 re-draws its original
     stream (see inference/sampling.py).  Unused by greedy.
     ``shardings`` threads the tensor-parallel constraints (see _tpc).
+    ``collect_probs`` (static) makes the body return ``(carry,
+    probs [B, V])`` — the post-filter sampling distribution of this
+    step (``filtered_probs``), the draft-side q surface speculative
+    decoding's rejection acceptance consumes.
 
     carry: (tokens [B], positions [B], lens [B], k_pages, v_pages,
     k_scales, v_scales, key) → the same tuple one step later, with the
@@ -503,10 +515,17 @@ def _decode_one_token_fn(stack, norm_w, head_w, embed_w, rope, tables,
                                temperature=temperature,
                                row_ids=row_ids)
         if arch is None:
-            return (nxt, positions + 1, lens + 1, k_pages, v_pages,
-                    k_scales, v_scales, key)
-        return (nxt, positions + 1, lens + 1, k_pages, v_pages,
-                k_scales, v_scales, key, counts_acc + cnts)
+            out = (nxt, positions + 1, lens + 1, k_pages, v_pages,
+                   k_scales, v_scales, key)
+        else:
+            out = (nxt, positions + 1, lens + 1, k_pages, v_pages,
+                   k_scales, v_scales, key, counts_acc + cnts)
+        if collect_probs:
+            from ..nn.generation import filtered_probs
+            return out, filtered_probs(
+                logits, strategy=strategy, top_k=top_k, top_p=top_p,
+                temperature=temperature)
+        return out
 
     return one_token
 
@@ -679,7 +698,7 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
                    transpose_head: bool = False,
                    strategy: str = "greedy_search", top_k: int = 0,
                    top_p: float = 1.0, temperature: float = 1.0,
-                   shardings=None, arch=None):
+                   shardings=None, arch=None, return_probs=False):
     """Un-jitted body of ``_paged_mixed_step`` — ALSO the per-step body
     of ``_paged_mixed_window``'s on-device loop, which is what makes
     the scanned window bit-identical to host-chained dispatch: the two
@@ -793,15 +812,26 @@ def _mixed_forward(stack, norm_w, head_w, embed_w, rope,
                            top_k=top_k, top_p=top_p,
                            temperature=temperature, row_ids=row_ids)
     if arch is None:
-        return nxt, k_pages, v_pages, k_scales, v_scales, key
-    return nxt, k_pages, v_pages, k_scales, v_scales, key, cnts
+        out = (nxt, k_pages, v_pages, k_scales, v_scales, key)
+    else:
+        out = (nxt, k_pages, v_pages, k_scales, v_scales, key, cnts)
+    if return_probs:
+        # static flag (speculative verify, sampled mode): append the
+        # per-row post-filter target distribution — the p surface the
+        # rejection acceptance consumes — WITHOUT touching the default
+        # trace (greedy speculative verify reuses the plain program)
+        from ..nn.generation import filtered_probs
+        return out + (filtered_probs(
+            logits, strategy=strategy, top_k=top_k, top_p=top_p,
+            temperature=temperature),)
+    return out
 
 
 @functools.partial(
     __import__("jax").jit,
     static_argnames=("eps", "kvh", "head_dim", "transpose_head",
                      "strategy", "top_k", "top_p", "temperature",
-                     "shardings", "arch"),
+                     "shardings", "arch", "return_probs"),
     donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
 def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
                       k_pages, v_pages, k_scales, v_scales,
@@ -812,7 +842,7 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
                       transpose_head: bool = False,
                       strategy: str = "greedy_search", top_k: int = 0,
                       top_p: float = 1.0, temperature: float = 1.0,
-                      shardings=None, arch=None):
+                      shardings=None, arch=None, return_probs=False):
     """ONE compiled program for the whole MIXED prefill+decode batch
     (the ragged unified step): a flat token batch of T rows — every
     active decode slot contributes 1 row, each pending prefill chunk
@@ -845,7 +875,7 @@ def _paged_mixed_step(stack, norm_w, head_w, embed_w, rope,
         eps=eps, kvh=kvh, head_dim=head_dim,
         transpose_head=transpose_head, strategy=strategy,
         top_k=top_k, top_p=top_p, temperature=temperature,
-        shardings=shardings, arch=arch)
+        shardings=shardings, arch=arch, return_probs=return_probs)
 
 
 @functools.partial(
@@ -972,7 +1002,8 @@ class LLMEngine:
                  mesh=None, tp_axis: str = "tp",
                  moe_dispatch: str = "grouped",
                  moe_dropless: bool = True,
-                 moe_capacity_factor: Optional[float] = None):
+                 moe_capacity_factor: Optional[float] = None,
+                 draft_model=None, spec_k: int = 4):
         import math
 
         import jax
@@ -1348,11 +1379,179 @@ class LLMEngine:
                 "shared": self._arch.shared,
                 "shared_gate": self._arch.shared_gate,
             },
+            # TOKEN-AFFECTING speculative geometry (filled by
+            # _init_spec): a changed draft model / k / acceptance mode
+            # must refuse replay via fingerprint_mismatch.  None for
+            # plain engines — greedy speculative streams are
+            # bit-identical to plain decode, but SAMPLED acceptance
+            # draws depend on the draft's q, so the conservative
+            # contract covers both modes.
+            "spec": None,
         }
+        self._spec = None
+        if draft_model is not None:
+            self._init_spec(draft_model, spec_k, dtype, page_size,
+                            weight_dtype)
 
     def config_fingerprint(self) -> dict:
         """This engine's capsule config fingerprint (copy)."""
         return dict(self._capsule_fp)
+
+    # -- speculative decoding --------------------------------------------------
+    def _init_spec(self, draft_model, spec_k: int, dtype, page_size: int,
+                   weight_dtype):
+        """Attach a DRAFT backbone for speculative decoding: its
+        weights stack into the same serving pytrees as the target's
+        (dense order — MoE drafts are refused; drafts are small), its
+        KV rides a second ``PagedKVCache`` with the draft's geometry,
+        and per-request draft slots attach LAZILY at the first
+        speculative window (one hook covers admission, deferred
+        prefill, resume — both restore paths — and import; suspend /
+        abort / retire just release).  The draft always runs
+        REPLICATED (``shardings=None``): tp shards the target, whose
+        verify dispatch dominates — and greedy acceptance never
+        depends on draft numerics, only on how often it matches.
+
+        Compile surface, declared: one extra ``engine.prefill_chunk``
+        trace (draft geometry), two ``engine.spec_draft`` traces
+        (propose ``n_steps=spec_k`` + 1-step catch-up), one
+        ``engine.spec_verify`` trace (the ragged mixed program at the
+        static ``T_spec = max_seqs * (spec_k + 1)`` bucket — runtime k
+        stays traced data, so churning k never recompiles)."""
+        import jax.numpy as jnp
+
+        from ..quantization.layers import QuantizedLinear
+        from ..quantization.ops import quantize_absmax_raw
+        from .backbone import resolve_backbone
+
+        enforce(spec_k >= 1, "spec_k must be >= 1")
+        dspec = resolve_backbone(draft_model)
+        enforce(dspec.moe is None,
+                "speculative draft must be a dense backbone "
+                "(MoE drafts defeat the point of a small draft)")
+        c, dc = self._backbone.config, dspec.config
+        enforce(dc.vocab_size == c.vocab_size,
+                f"draft vocab ({dc.vocab_size}) must match target "
+                f"vocab ({c.vocab_size})")
+        d_maxpos = int(np.asarray(dspec.rope_cos.value).shape[0])
+        t_maxpos = int(np.asarray(self._backbone.rope_cos.value).shape[0])
+        enforce(d_maxpos >= min(self.max_len, t_maxpos),
+                f"draft max_position_embeddings ({d_maxpos}) too short "
+                f"for the engine's sequence limit "
+                f"({min(self.max_len, t_maxpos)})")
+        self.spec_k = int(spec_k)
+        self._spec_mode = "greedy" \
+            if self.decode_strategy == "greedy_search" else "rejection"
+        layers = dspec.layers
+
+        def stackp(get):
+            return jnp.stack([get(l).value for l in layers])
+
+        def stackw(get):
+            mods = [get(l) for l in layers]
+            if any(isinstance(m, QuantizedLinear) for m in mods):
+                enforce(all(isinstance(m, QuantizedLinear)
+                            for m in mods),
+                        "mixed fp/int8 Linears across draft layers")
+                return (jnp.stack([m.qweight.value for m in mods]),
+                        jnp.stack([m.weight_scale.value
+                                   for m in mods]))
+            ws = jnp.stack([m.weight.value for m in mods])
+            if weight_dtype == "int8":
+                return quantize_absmax_raw(ws, axis=1)
+            return ws
+
+        d_stack = (
+            stackp(lambda l: l.input_layernorm.weight),
+            stackw(lambda l: l.self_attn.q_proj),
+            stackw(lambda l: l.self_attn.k_proj),
+            stackw(lambda l: l.self_attn.v_proj),
+            stackw(lambda l: l.self_attn.o_proj),
+            stackp(lambda l: l.post_attention_layernorm.weight),
+            stackw(lambda l: l.mlp.gate_proj),
+            stackw(lambda l: l.mlp.up_proj),
+            stackw(lambda l: l.mlp.down_proj),
+        )
+        d_tied = dspec.lm_head is None
+        if d_tied:
+            d_head = dspec.embed_tokens.weight.value
+        elif isinstance(dspec.lm_head, QuantizedLinear):
+            d_head = (dspec.lm_head.qweight.value,
+                      dspec.lm_head.weight_scale.value)
+        elif weight_dtype == "int8":
+            d_head = quantize_absmax_raw(
+                dspec.lm_head.weight.value, axis=0)
+        else:
+            d_head = dspec.lm_head.weight.value
+        rope = (np.asarray(dspec.rope_cos.value),
+                np.asarray(dspec.rope_sin.value))
+        d_rope = (jnp.asarray(rope[0]), jnp.asarray(rope[1]))
+        pad_to = -(-max(d_maxpos, page_size) // page_size) * page_size
+        if pad_to != d_maxpos:
+            padr = ((0, pad_to - d_maxpos), (0, 0))
+            d_rope_prefill = (jnp.asarray(np.pad(rope[0], padr)),
+                              jnp.asarray(np.pad(rope[1], padr)))
+        else:
+            d_rope_prefill = d_rope
+        # draft KV pool: the draft's geometry, full slot capacity (no
+        # prefix sharing thins it like the target's), no swap pool —
+        # suspended drafts are cheaper to RECOMPUTE than to swap
+        self._spec_cache = PagedKVCache(
+            n_pages=self.max_seqs * (self.max_len // page_size) + 1,
+            page_size=page_size,
+            n_kv_heads=dc.num_key_value_heads,
+            head_dim=dc.hidden_size // dc.num_attention_heads,
+            max_seqs=self.max_seqs, max_len=self.max_len, dtype=dtype,
+            num_layers=len(layers),
+            kv_dtype="int8" if self.kv_dtype == "int8" else None,
+            swap_pool_pages=0, shardings=None)
+        self._spec = {
+            "stack": d_stack, "norm_w": dspec.norm.weight.value,
+            "head_w": d_head, "embed_w": dspec.embed_tokens.weight.value,
+            "rope": d_rope, "rope_prefill": d_rope_prefill,
+            "tied": d_tied, "eps": dc.rms_norm_eps,
+            "kvh": dc.num_key_value_heads,
+            "head_dim": dc.hidden_size // dc.num_attention_heads,
+        }
+        # host-side acceptance accounting (kept even with metrics off —
+        # metrics_snapshot()/statusz/the bench read it directly):
+        # ``accepted`` counts surviving DRAFT tokens only; the bonus /
+        # correction token rides ``delivered``
+        self.spec_stats = {"windows": 0, "proposed": 0, "accepted": 0,
+                           "delivered": 0}
+        cw = _insp.get_compile_watch()
+        cw.register_program("engine.prefill_chunk")  # draft geometry
+        cw.register_program("engine.spec_draft", expected=2)
+        cw.register_program("engine.spec_verify")
+        _insp.register_memory_consumer(
+            f"kv_cache_draft:{self.engine_id}", self._spec_cache)
+        self._capsule_fp["spec"] = {
+            "draft_hash": _capsule.model_fingerprint(draft_model),
+            "k": self.spec_k, "mode": self._spec_mode}
+        if self._metrics is not None:
+            reg = get_registry()
+            lbl = ("engine",)
+            eid = self.engine_id
+            self._metrics["spec_proposed"] = reg.counter(
+                "llm_engine_spec_proposed_total",
+                "Draft tokens proposed to speculative verify "
+                "windows.", lbl).labels(eid)
+            self._metrics["spec_accepted"] = reg.counter(
+                "llm_engine_spec_accepted_total",
+                "Draft tokens accepted by the target (bonus/"
+                "correction tokens excluded).", lbl).labels(eid)
+            self._metrics["spec_rate"] = reg.gauge(
+                "llm_engine_spec_acceptance_rate",
+                "Cumulative accepted/proposed draft-token ratio.",
+                lbl).labels(eid)
+            # fixed ladder (NOT spec_k-derived): the registry enforces
+            # one bucket set per metric name process-wide, and
+            # engines with different k must share it
+            self._metrics["spec_len"] = reg.histogram(
+                "llm_engine_spec_accepted_len",
+                "Accepted draft tokens per sequence per speculative "
+                "window.", lbl,
+                buckets=_SPEC_LEN_BUCKETS).labels(eid)
 
     # -- metrics ---------------------------------------------------------------
     def _init_metrics(self, enabled: bool):
@@ -1622,6 +1821,375 @@ class LLMEngine:
             self.cache.advance([slot], nsteps)
             i += nsteps
 
+    # -- speculative window internals ------------------------------------------
+    def _spec_prefill(self, dslot, seq):
+        """Chunked prefill of ``seq`` into DRAFT slot ``dslot`` —
+        ``_prefill_seq``'s mirror over the draft weights and cache
+        (replicated, dense ``arch=None``).  Rides the same
+        ``engine.prefill_chunk`` watch point; its one extra trace
+        (draft geometry) is declared at ``_init_spec``."""
+        import jax.numpy as jnp
+
+        sp = self._spec
+        dcache = self._spec_cache
+        P = dcache.page_size
+        plen = len(seq)
+        table = np.asarray(dcache.page_table[dslot])
+        for ci in range(-(-plen // P)):
+            base = ci * P
+            chunk = np.zeros(P, np.int32)
+            real = min(P, plen - base)
+            chunk[:real] = np.asarray(seq[base:base + real], np.int32)
+            out = _insp.watched_call(
+                "engine.prefill_chunk", _paged_prefill_chunk,
+                sp["stack"], sp["norm_w"], sp["head_w"],
+                sp["embed_w"], sp["rope_prefill"],
+                dcache.k_pages, dcache.v_pages,
+                dcache.k_scales, dcache.v_scales,
+                jnp.asarray(chunk), jnp.asarray(table),
+                jnp.int32(base), jnp.int32(int(table[ci])),
+                jnp.int32(min(plen - 1 - base, P - 1)),
+                eps=sp["eps"], kvh=sp["kvh"],
+                head_dim=sp["head_dim"], transpose_head=sp["tied"],
+                shardings=None, arch=None)
+            (_, dcache.k_pages, dcache.v_pages, dcache.k_scales,
+             dcache.v_scales) = out
+        dcache.set_len(dslot, plen)
+
+    def _spec_attach(self, req):
+        """Lazily attach the request's DRAFT KV slot at its first
+        speculative window: allocate the full page reservation on the
+        draft cache and chunk-prefill ``prompt + out[:-1]`` — the
+        draft mirror of the target's window-start state (KV through
+        position ``cur - 1``, next input ``out[-1]``).  ONE hook
+        covers every way a request reaches decode — admission,
+        deferred prefill, resume via either restore path, import —
+        because all of them land in ``_step_spec`` with a bare
+        ``draft_slot``; retire / suspend / abort just release."""
+        seq = list(req.prompt) + req.out[:-1]
+        req.draft_slot = self._spec_cache.allocate(
+            len(req.prompt) + req.max_new)
+        self._spec_prefill(req.draft_slot, seq)
+
+    def _spec_release(self, req):
+        """Drop the request's draft slot (retire / suspend / abort /
+        capsule-replay scratch).  Guarded no-op when the request never
+        reached a speculative window — the lazy attach means plain
+        interludes and first-token retires hold no draft state."""
+        if self._spec is not None and req.draft_slot is not None:
+            self._spec_cache.release(req.draft_slot)
+            req.draft_slot = None
+
+    def _spec_window(self, rows, sub, k_run):
+        """One speculative window over ``rows`` (dicts with the
+        request's target ``slot``, ``dslot``, ``last`` input token,
+        ``cur`` KV length, full token ``seq`` and draw-id ``row``):
+        draft catch-up + propose, ONE ragged target verify, accept,
+        and the advance/rollback bookkeeping on BOTH caches.  Returns
+        ``[(delivered_tokens, n_accepted)]`` aligned with ``rows`` and
+        touches no request state — capsule replay re-invokes it with a
+        single scratch row, which is why draws key off ``row`` (the
+        CAPTURED batch index) and never off packing position.
+
+        ``sub`` is the window's engine-key fork; ``spec_window_keys``
+        derives the draft / accept / resample roots from it, so the
+        engine key stream is identical to a plain window's and the
+        capsule's per-window key fingerprint replays either kind.
+
+        ``k_run`` (<= ``spec_k``) is the runtime draft length — TRACED
+        data in both programs: propose always runs the static
+        ``spec_k`` steps (overrun rows land in reserved pages or the
+        pad page and are never attended), verify always dispatches the
+        static ``T_spec = max_seqs * (spec_k + 1)`` bucket with
+        ``q_len`` descriptors carving out the live ``k_run + 1`` rows
+        — so churning ``k_run`` never recompiles."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import speculative as _spec_mod
+
+        sp = self._spec
+        dcache = self._spec_cache
+        sampled = self._spec_mode == "rejection"
+        draft_root, accept_root, resample_root = \
+            _sampling.spec_window_keys(sub)
+        B = self.max_seqs
+        maxp_d = dcache.page_table.shape[1]
+
+        # -- draft catch-up: teacher-force the draft level with the
+        # target (deficit 1 after a fully-accepted window — the bonus
+        # token's KV was never drafted — or more after plain-decode
+        # interludes), one 1-step program dispatch per deficit level;
+        # rows already level ride along as len-0 pad rows
+        while True:
+            lag = [r for r in rows
+                   if int(dcache.seq_lens[r["dslot"]]) < r["cur"]]
+            if not lag:
+                break
+            ids = np.zeros(B, np.int32)
+            pos = np.zeros(B, np.int32)
+            tabs = np.zeros((B, maxp_d), np.int32)
+            lens = np.zeros(B, np.int32)
+            dslots = []
+            for j, r in enumerate(lag):
+                dl = int(dcache.seq_lens[r["dslot"]])
+                dcache.extend(r["dslot"], 1)
+                ids[j] = r["seq"][dl]
+                pos[j] = dl
+                tabs[j] = dcache.page_table[r["dslot"]]
+                lens[j] = dl
+                dslots.append(r["dslot"])
+            res = _insp.watched_call(
+                "engine.spec_draft", _spec_mod._paged_draft_propose,
+                sp["stack"], sp["norm_w"], sp["head_w"],
+                sp["embed_w"], sp["rope"],
+                dcache.k_pages, dcache.v_pages,
+                dcache.k_scales, dcache.v_scales,
+                jnp.asarray(ids), jnp.asarray(pos),
+                jnp.asarray(tabs), jnp.asarray(lens),
+                jax.random.PRNGKey(0), jnp.int32(0),
+                eps=sp["eps"], kvh=sp["kvh"],
+                head_dim=sp["head_dim"], transpose_head=sp["tied"],
+                n_steps=1, collect_probs=False, shardings=None)
+            (_, dcache.k_pages, dcache.v_pages, dcache.k_scales,
+             dcache.v_scales) = res
+            dcache.advance(dslots, 1)
+
+        # -- propose: spec_k free-running draft tokens per row as ONE
+        # program; the host advances only k_run (overrun rows are
+        # garbage-by-construction: within the slot's reservation they
+        # sit above the length watermark, past it the zero table
+        # entries land them in pad page 0)
+        ids = np.zeros(B, np.int32)
+        pos = np.zeros(B, np.int32)
+        tabs = np.zeros((B, maxp_d), np.int32)
+        lens = np.zeros(B, np.int32)
+        dslots = [r["dslot"] for r in rows]
+        for j, r in enumerate(rows):
+            dcache.extend(r["dslot"], k_run)
+            ids[j] = r["last"]
+            pos[j] = r["cur"]
+            tabs[j] = dcache.page_table[r["dslot"]]
+            lens[j] = r["cur"]
+        db = rows[0]["row"] if len(rows) == 1 else 0
+        res = _insp.watched_call(
+            "engine.spec_draft", _spec_mod._paged_draft_propose,
+            sp["stack"], sp["norm_w"], sp["head_w"], sp["embed_w"],
+            sp["rope"], dcache.k_pages, dcache.v_pages,
+            dcache.k_scales, dcache.v_scales,
+            jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(tabs),
+            jnp.asarray(lens), draft_root, jnp.int32(db),
+            eps=sp["eps"], kvh=sp["kvh"], head_dim=sp["head_dim"],
+            transpose_head=sp["tied"], strategy=self.decode_strategy,
+            top_k=self.top_k, top_p=self.top_p,
+            temperature=self.temperature, n_steps=self.spec_k,
+            collect_probs=sampled, shardings=None)
+        if sampled:
+            (toks_d, dcache.k_pages, dcache.v_pages, dcache.k_scales,
+             dcache.v_scales, q_all) = res
+            q_all = np.asarray(jax.device_get(q_all), np.float64)
+        else:
+            (toks_d, dcache.k_pages, dcache.v_pages, dcache.k_scales,
+             dcache.v_scales) = res
+        toks_d = np.asarray(jax.device_get(toks_d))  # [spec_k, B]
+        dcache.advance(dslots, k_run)
+
+        # -- verify: ONE ragged mixed dispatch scores every row's
+        # whole draft window — k_run + 1 rows [last, d_1..d_k] per
+        # sequence, descriptors split at page boundaries for the TPU
+        # kernel's ``kv_len % P + q_len <= P`` contract (descriptor
+        # index = the segment's first flat row, so live descriptors
+        # never collide with pad rows' self-descriptors)
+        P = self.cache.page_size
+        maxp = self.cache.page_table.shape[1]
+        T = self.max_seqs * (self.spec_k + 1)
+        kw = k_run + 1
+        v_ids = np.zeros(T, np.int32)
+        positions = np.zeros(T, np.int32)
+        row_tables = np.zeros((T, maxp), np.int32)
+        q_start = np.zeros(T, np.int32)
+        q_len = np.zeros(T, np.int32)
+        kv_len = np.zeros(T, np.int32)
+        desc_tables = np.zeros((T, maxp), np.int32)
+        desc_of_row = np.arange(T, dtype=np.int32)
+        off_of_row = np.zeros(T, np.int32)
+        slots = [r["slot"] for r in rows]
+        for i, r in enumerate(rows):
+            self.cache.extend(r["slot"], kw)
+            tbl = self.cache.page_table[r["slot"]]
+            r0 = i * kw
+            v_ids[r0] = r["last"]
+            v_ids[r0 + 1:r0 + kw] = toks_d[:k_run, i]
+            positions[r0:r0 + kw] = np.arange(r["cur"],
+                                              r["cur"] + kw)
+            row_tables[r0:r0 + kw] = tbl
+            s = 0
+            while s < kw:
+                pos0 = r["cur"] + s
+                seg = min(kw - s, P - pos0 % P)
+                d = r0 + s
+                q_start[d] = r0 + s
+                q_len[d] = seg
+                kv_len[d] = pos0
+                desc_tables[d] = tbl
+                desc_of_row[r0 + s:r0 + s + seg] = d
+                off_of_row[r0 + s:r0 + s + seg] = np.arange(seg)
+                s += seg
+        res = _insp.watched_call(
+            "engine.spec_verify", _paged_mixed_step,
+            self._stack, self._norm_w, self._head_w, self._embed_w,
+            self._rope, self.cache.k_pages, self.cache.v_pages,
+            self.cache.k_scales, self.cache.v_scales,
+            jnp.asarray(v_ids), jnp.asarray(positions),
+            jnp.asarray(row_tables), jnp.asarray(q_start),
+            jnp.asarray(q_len), jnp.asarray(kv_len),
+            jnp.asarray(desc_tables), jnp.asarray(desc_of_row),
+            jnp.asarray(off_of_row), sub, jnp.int32(0),
+            eps=self.eps, kvh=self.kvh, head_dim=self.head_dim,
+            transpose_head=self._tied, strategy=self.decode_strategy,
+            top_k=self.top_k, top_p=self.top_p,
+            temperature=self.temperature, shardings=self._shardings,
+            arch=self._arch, return_probs=sampled)
+        (nxt, self.cache.k_pages, self.cache.v_pages,
+         self.cache.k_scales, self.cache.v_scales, _) = res[:6]
+        if self._arch is not None:
+            self._note_expert_counts(
+                res[6], len(rows) * kw * self._arch.top_k)
+        if sampled:
+            p_all = np.asarray(jax.device_get(res[-1]), np.float64)
+        nxt = np.asarray(jax.device_get(nxt))
+        self.cache.advance(slots, kw)
+
+        # -- accept + rejected-suffix rollback on both caches: the
+        # target keeps rows for [last, d_1..d_a] (the delivered
+        # correction/bonus token's KV appends next window); the draft
+        # keeps [last, d_1..d_{a-1}] when a < k_run (mirror level
+        # cur + a + 1) and stays one short after full acceptance —
+        # next window's catch-up teacher-forces d_k
+        out = []
+        for i, r in enumerate(rows):
+            r0 = i * kw
+            if sampled:
+                toks, a = _spec_mod.rejection_accept(
+                    toks_d[:k_run, i], q_all[:k_run, i],
+                    p_all[r0:r0 + kw], accept_root, resample_root,
+                    r["row"])
+            else:
+                toks, a = _spec_mod.greedy_accept(
+                    toks_d[:k_run, i], nxt[r0:r0 + kw])
+            self.cache.rollback(r["slot"], k_run - a)
+            if a < k_run:
+                dcache.rollback(r["dslot"], k_run - a - 1)
+            out.append((toks, a))
+        return out
+
+    def _step_spec(self) -> Dict[object, List[int]]:
+        """The speculative decode window: draft-propose ``k_run``
+        tokens per active request, verify them all in ONE ragged
+        target dispatch, deliver the accepted prefix plus the
+        correction/bonus token.  Greedy acceptance is BIT-IDENTICAL to
+        plain decode (the verify rows' argmaxes ARE the plain stream);
+        rejection acceptance preserves the target's post-filter
+        sampling distribution for any draft.  Windows with pending
+        prefill fall back to the plain unified step — chunked prefill
+        interleaving is that path's job, and plain greedy windows are
+        the same token stream anyway; drafts catch back up at the next
+        speculative window."""
+        import jax
+
+        if self._prefilling:
+            return self._step_mixed()
+        if not self._active:
+            return {}
+        batch = list(self._active)
+        for req in batch:
+            if req.draft_slot is None:
+                self._spec_attach(req)
+        # runtime draft length: never draft past the tightest budget
+        # (the window delivers at most k_run + 1 <= remaining + 1
+        # tokens; the merge loop truncates the last one exactly like a
+        # plain multi-step window)
+        k_run = min([self.spec_k] +
+                    [r.max_new - len(r.out) for r in batch])
+        k_run = max(k_run, 1)
+        self._key, sub = jax.random.split(self._key)
+        rows = [{"slot": r.slot, "dslot": r.draft_slot,
+                 "last": r.out[-1],
+                 "cur": len(r.prompt) + len(r.out) - 1,
+                 "seq": list(r.prompt) + r.out, "row": i}
+                for i, r in enumerate(batch)]
+        t_win = time.perf_counter()
+        span = _tracing.span("engine.spec_window")
+        span.set_attr("rows", len(batch))
+        span.set_attr("k_run", k_run)
+        try:
+            with RecordEvent("llm_engine.decode"):
+                results = self._spec_window(rows, sub, k_run)
+        finally:
+            span.end()
+        dt_win = time.perf_counter() - t_win
+
+        out = {}
+        accepted = {}
+        for i, req in enumerate(batch):
+            toks, _a = results[i]
+            accepted[req.rid] = int(_a)
+            new_toks = []
+            for tok in toks:
+                if req.done:
+                    break
+                req.out.append(tok)
+                new_toks.append(tok)
+                if (req.eos is not None and tok == req.eos) or \
+                        len(req.out) >= req.max_new:
+                    req.done = True
+                    self.cache.release(req.slot)
+                    self._spec_release(req)
+                    self._active.remove(req)
+            if new_toks:
+                out[req.rid] = new_toks
+        delivered = max((len(v) for v in out.values()), default=0)
+        self.last_window_steps = delivered
+
+        n_prop = len(batch) * k_run
+        n_acc = sum(a for (_, a) in results)
+        st = self.spec_stats
+        st["windows"] += 1
+        st["proposed"] += n_prop
+        st["accepted"] += n_acc
+        st["delivered"] += sum(len(v) for v in out.values())
+
+        cs = _capsule.get_capsule_store()
+        if cs.enabled and out:
+            cs.on_window(out, _sampling.key_fingerprint(sub),
+                         k_run + 1, delivered, "spec_window",
+                         rows={r.rid: i for i, r in enumerate(batch)},
+                         accepted=accepted)
+        # TPOT counts only DELIVERED tokens: dt_win amortizes over the
+        # window's real payoff, so a low-acceptance draft shows up as
+        # WORSE per-token latency, not phantom throughput (proposed-
+        # but-rejected tokens never touch the histogram or the AIMD
+        # SLO window)
+        if delivered:
+            _health.get_health().observe_tpot(dt_win / delivered,
+                                              n=delivered)
+        if self._metrics is not None:
+            m = self._metrics
+            if delivered:
+                m["tpot"].observe(dt_win / delivered, n=delivered)
+            m["generated_tokens"].inc(
+                sum(len(v) for v in out.values()))
+            m["queue_depth"].set(len(self._active))
+            m["occupancy"].set(len(batch) / self.max_seqs)
+            m["spec_proposed"].inc(n_prop)
+            m["spec_accepted"].inc(n_acc)
+            if st["proposed"]:
+                m["spec_rate"].set(st["accepted"] / st["proposed"])
+            for _, a in results:
+                m["spec_len"].observe(float(a))
+            self._record_compiles()
+        return out
+
     # -- admission -------------------------------------------------------------
     def add_request(self, rid, prompt_ids, max_new_tokens: int = 64,
                     eos_token_id: Optional[int] = None):
@@ -1828,7 +2396,15 @@ class LLMEngine:
         decode instead of stalling it.  Tokens are bit-identical to
         the split-program path (greedy decoding; the per-row programs
         agree op for op).  With ``unified_step=False`` the original
-        split decode-only dispatch runs (``_paged_decode_step``)."""
+        split decode-only dispatch runs (``_paged_decode_step``).
+
+        A ``draft_model`` engine routes pure-decode windows through
+        the speculative path (``_step_spec``): greedy streams stay
+        bit-identical to plain decode, sampled streams stay
+        distributionally exact — only the tokens-per-dispatch ratio
+        changes."""
+        if self._spec is not None:
+            return self._step_spec()
         if self.unified_step:
             return self._step_mixed()
         return self._step_split()
@@ -1960,6 +2536,7 @@ class LLMEngine:
                         len(req.out) >= req.max_new:
                     req.done = True
                     self.cache.release(req.slot)
+                    self._spec_release(req)
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
@@ -2236,6 +2813,7 @@ class LLMEngine:
                         len(req.out) >= req.max_new:
                     req.done = True
                     self.cache.release(req.slot)
+                    self._spec_release(req)
                     self._active.remove(req)
             if new_toks:
                 out[req.rid] = new_toks
@@ -2266,6 +2844,7 @@ class LLMEngine:
                     req.max_new <= 1:
                 req.done = True
                 self.cache.release(req.slot)
+                self._spec_release(req)
             else:
                 self._active.append(req)
         # capsule capture after the finishing loop, so prefill-
@@ -2375,6 +2954,10 @@ class LLMEngine:
                 self._metrics["queue_depth"].set(len(self._active))
             return False
         self._active.remove(req)
+        # the draft slot never swaps — a suspended draft is cheaper to
+        # re-prefill at the next speculative window (lazy re-attach)
+        # than to hold pages or pool space for
+        self._spec_release(req)
         with _tracing.span("engine.swap_out") as sp:
             req.swap_handle = self.cache.swap_out(req.slot)
             sp.set_attr("rid", str(rid))
@@ -2580,6 +3163,7 @@ class LLMEngine:
         elif req in self._active:
             self._active.remove(req)
             self.cache.release(req.slot)
+            self._spec_release(req)
         elif req in self._prefilling:
             self._prefilling.remove(req)
             self.cache.release(req.slot)
@@ -2723,6 +3307,26 @@ class LLMEngine:
                 "dropped_tokens": int(self._moe_dropped),
                 "imbalance": (float(tot.max() / tot.mean())
                               if tot.sum() else 0.0),
+            }
+        if self._spec is not None:
+            # speculative acceptance plane (host counters — present
+            # with metrics off too): proposed counts DRAFT tokens
+            # offered to verify, accepted the survivors, delivered
+            # every token returned to requests (bonus / correction
+            # included)
+            st = self.spec_stats
+            snap["spec"] = {
+                "enabled": True,
+                "k": self.spec_k,
+                "mode": self._spec_mode,
+                "draft_hash": self._capsule_fp["spec"]["draft_hash"],
+                "windows": int(st["windows"]),
+                "proposed": int(st["proposed"]),
+                "accepted": int(st["accepted"]),
+                "delivered": int(st["delivered"]),
+                "acceptance_rate": (st["accepted"] / st["proposed"]
+                                    if st["proposed"] else 0.0),
+                "kv_cache_draft": self._spec_cache.metrics_snapshot(),
             }
         if self._metrics is not None:
             m = self._metrics

@@ -724,6 +724,25 @@ class PagedKVCache:
         for s in np.atleast_1d(slots):
             self._lens[s] += n
 
+    def rollback(self, slot: int, n: int):
+        """Un-append the last ``n`` tokens of ``slot`` (speculative
+        decoding's rejected-suffix rollback): a host-side ``_lens``
+        decrement and NOTHING else — the mirror of ``advance``'s
+        under-advance contract.  The rejected rows' K/V (and, for int8
+        pools, their scale rows) stay physically in the pages but are
+        never attended (every attention path masks at ``kv_pos <
+        len``) and the next append overwrites them in place, scale
+        rows traveling alongside.  Pages stay attached to the slot —
+        release-safe: ``release`` still walks the full table, and
+        re-appending never re-grabs pages the slot already holds."""
+        n = int(n)
+        enforce(n >= 0, f"rollback of {n} tokens")
+        enforce(self._used[slot], f"rollback on free slot {slot}")
+        enforce(self._lens[slot] >= n,
+                f"rollback of {n} tokens but slot {slot} holds "
+                f"{int(self._lens[slot])}")
+        self._lens[slot] -= n
+
     @property
     def seq_lens(self) -> np.ndarray:
         return self._lens

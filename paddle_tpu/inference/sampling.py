@@ -37,7 +37,42 @@ from __future__ import annotations
 from ..nn.generation import sample_logits
 
 __all__ = ["split_step", "window_keys", "key_fingerprint",
-           "key_from_fingerprint", "sample_logits", "fold_row"]
+           "key_from_fingerprint", "sample_logits", "fold_row",
+           "spec_window_keys", "spec_draw_key"]
+
+# Speculative windows fork ONE subkey off the engine key like every
+# other window and derive every draw inside it from that fork via
+# fold_in tags — the engine key stream is identical whether a window
+# decodes plainly or speculatively, so capsules replay across both.
+_SPEC_DRAFT_TAG = 0x5bec0d01     # draft propose chain root
+_SPEC_ACCEPT_TAG = 0x5bec0d02    # acceptance-uniform root
+_SPEC_RESAMPLE_TAG = 0x5bec0d03  # rejection-resample / bonus root
+
+
+def spec_window_keys(key):
+    """Derive one speculative window's (draft, accept, resample) key
+    roots from its forked window key.  THE single definition — the
+    live window and capsule replay both derive here, so the two
+    cannot drift.  The draft root seeds the propose program's
+    ``split_step`` chain; accept/resample roots seed per-(step, row)
+    draws via ``spec_draw_key``."""
+    import jax
+
+    return (jax.random.fold_in(key, _SPEC_DRAFT_TAG),
+            jax.random.fold_in(key, _SPEC_ACCEPT_TAG),
+            jax.random.fold_in(key, _SPEC_RESAMPLE_TAG))
+
+
+def spec_draw_key(root, step: int, row: int):
+    """Per-(step, row) acceptance/resample draw key: the step folds
+    first, then the row via ``fold_row`` — mirroring the decode
+    window's ``split_step`` × ``fold_row`` grid, so a request's
+    acceptance draws depend on its draw id (``draw_base + batch
+    row``) and never on batch packing.  Replay re-pins a request by
+    passing its CAPTURED row, exactly like token sampling."""
+    import jax
+
+    return fold_row(jax.random.fold_in(root, int(step)), int(row))
 
 
 def fold_row(key, row):

@@ -1,0 +1,210 @@
+"""Speculative decoding: draft-model propose, one-dispatch ragged
+verify, bit-exact accept.
+
+The engine (``LLMEngine(draft_model=...)``) runs decode windows in
+three moves:
+
+1. PROPOSE — the draft backbone (its own paged KV slot in a second
+   ``PagedKVCache``) free-runs ``spec_k`` tokens per sequence in ONE
+   compiled program (``_paged_draft_propose``, the same
+   ``_decode_one_token_fn`` step body as plain decode, so the draft's
+   key chain follows the standard ``split_step`` × ``fold_row`` grid).
+2. VERIFY — the target scores the whole draft window per sequence in
+   ONE ragged mixed dispatch (``engine._paged_mixed_step``): each
+   sequence contributes ``k+1`` rows ``[last, d_1..d_k]`` described by
+   per-sequence ``(q_start, q_len, kv_len)`` descriptors, split at
+   page boundaries for the TPU kernel's ``kv_len % P + q_len <= P``
+   contract.  ``k`` stays TRACED data inside the one static
+   ``T_spec = max_seqs * (spec_k + 1)`` bucket, so churning the
+   runtime ``k`` never recompiles.
+3. ACCEPT — this module.  Greedy: the verify rows' argmaxes ARE the
+   plain-greedy stream (row j's context is the prompt plus tokens the
+   target itself confirmed), so the longest prefix where the draft
+   matched plus the first correction is BIT-IDENTICAL to plain decode
+   — no distributions, no draws.  Sampling: standard rejection
+   acceptance (accept ``d_i`` w.p. ``min(1, p_i(d_i) / q_i(d_i))``,
+   resample the first reject from ``normalize(max(p - q, 0))``), which
+   preserves the target's post-filter distribution exactly for ANY
+   proposal q.  The bonus token unifies as "always reject at row k
+   with q := 0", whose residual is ``p`` itself.
+
+Rejected suffixes roll back via ``PagedKVCache.rollback`` — a
+host-side length decrement mirroring ``advance``; the stale rows are
+never attended and the next append overwrites them, int8 scale rows
+traveling alongside.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..common.errors import enforce
+
+__all__ = ["greedy_accept", "rejection_accept", "residual_dist",
+           "acceptance_uniforms"]
+
+
+@functools.partial(
+    __import__("jax").jit,
+    static_argnames=("eps", "kvh", "head_dim", "transpose_head",
+                     "strategy", "top_k", "top_p", "temperature",
+                     "n_steps", "collect_probs", "shardings"),
+    donate_argnames=("k_pages", "v_pages", "k_scales", "v_scales"))
+def _paged_draft_propose(stack, norm_w, head_w, embed_w, rope,
+                         k_pages, v_pages, k_scales, v_scales,
+                         tokens, positions, tables, lens,
+                         key, draw_base=0, *, eps: float, kvh: int,
+                         head_dim: int, transpose_head: bool = False,
+                         strategy: str = "greedy_search",
+                         top_k: int = 0, top_p: float = 1.0,
+                         temperature: float = 1.0, n_steps: int = 1,
+                         collect_probs: bool = False, shardings=None):
+    """The draft side of a speculative window: ``n_steps`` free-running
+    draft tokens for every row as ONE XLA program — the same step body
+    as ``_paged_decode_step`` (``_decode_one_token_fn``), dense
+    backbones only (drafts are small; MoE drafts are refused at engine
+    init).  Doubles as the draft CATCH-UP program with ``n_steps=1``
+    and teacher-forced inputs (outputs ignored), so the engine needs
+    exactly two trace shapes per draft geometry.
+
+    Returns (tokens [n_steps, B], k_pages', v_pages', k_scales',
+    v_scales') — plus a trailing post-filter draft distribution
+    ``q [n_steps, B, V]`` when ``collect_probs`` (the rejection
+    acceptance's q surface; greedy windows never pay for it)."""
+    import jax
+
+    from .engine import _decode_one_token_fn
+
+    one_token = _decode_one_token_fn(
+        stack, norm_w, head_w, embed_w, rope, tables,
+        eps=eps, kvh=kvh, head_dim=head_dim,
+        transpose_head=transpose_head, strategy=strategy, top_k=top_k,
+        top_p=top_p, temperature=temperature, draw_base=draw_base,
+        shardings=shardings, arch=None, collect_probs=collect_probs)
+
+    carry0 = (tokens, positions, lens, k_pages, v_pages, k_scales,
+              v_scales, key)
+
+    if not collect_probs:
+        def body(carry, _):
+            carry = one_token(carry)
+            return carry, carry[0]
+    else:
+        def body(carry, _):
+            carry, probs = one_token(carry)
+            return carry, (carry[0], probs)
+
+    final, ys = jax.lax.scan(body, carry0, None, length=n_steps)
+    (_, _, _, k_pages, v_pages, k_scales, v_scales, _) = final
+    if not collect_probs:
+        return ys, k_pages, v_pages, k_scales, v_scales
+    toks, probs = ys
+    return toks, k_pages, v_pages, k_scales, v_scales, probs
+
+
+def greedy_accept(draft_toks, target_toks):
+    """One row's greedy acceptance: ``draft_toks`` [k] are the draft's
+    proposals, ``target_toks`` [k+1] the verify rows' argmaxes (row j
+    = the target's next token after consuming ``[last, d_1..d_j]``).
+
+    Delivered tokens are ``target_toks[:a+1]`` where ``a`` is the
+    longest prefix with ``target_toks[j] == draft_toks[j]``: matched
+    rows deliver the draft token (== the argmax), the first mismatch
+    delivers the target's CORRECTION, full acceptance delivers the
+    BONUS row.  Row j's verify context is exactly the plain-greedy
+    context by induction, so the delivered stream is bit-identical to
+    plain greedy decode — the tentpole invariant.
+
+    Returns ``(tokens, n_accepted)``: the delivered token list
+    (``n_accepted + 1`` long) and how many DRAFT tokens survived."""
+    draft_toks = np.asarray(draft_toks)
+    target_toks = np.asarray(target_toks)
+    k = int(draft_toks.shape[0])
+    enforce(target_toks.shape[0] == k + 1,
+            "greedy_accept wants k+1 verify rows for k draft tokens")
+    a = 0
+    while a < k and int(target_toks[a]) == int(draft_toks[a]):
+        a += 1
+    return [int(t) for t in target_toks[:a + 1]], a
+
+
+def residual_dist(p, q):
+    """The rejection-resample distribution ``normalize(max(p - q, 0))``
+    [V] f64.  Degenerates to ``p`` when the residual mass vanishes
+    (p == q to rounding): the accept ratio was 1 everywhere, so any
+    fallback is distributionally moot — ``p`` keeps the draw defined
+    and deterministic."""
+    r = np.maximum(np.asarray(p, np.float64) - np.asarray(q, np.float64),
+                   0.0)
+    s = float(r.sum())
+    if s <= 1e-12:
+        p = np.asarray(p, np.float64)
+        return p / max(float(p.sum()), 1e-30)
+    return r / s
+
+
+def acceptance_uniforms(accept_root, steps: int, row: int):
+    """The row's acceptance uniforms ``u_0..u_{steps-1}`` — one eager
+    draw per step off ``spec_draw_key(accept_root, j, row)``.  Host
+    numpy out: the acceptance walk is host-side (k and B are tiny)."""
+    import jax
+
+    from .sampling import spec_draw_key
+
+    return [float(np.asarray(jax.random.uniform(
+        spec_draw_key(accept_root, j, row)))) for j in range(steps)]
+
+
+def rejection_accept(draft_toks, q_probs, p_probs, accept_root,
+                     resample_root, row):
+    """One row's rejection acceptance (sampled decoding).
+
+    ``draft_toks`` [k]: the draft's sampled proposals; ``q_probs``
+    [k, V]: the post-filter draft distribution each was drawn from;
+    ``p_probs`` [k+1, V]: the target's post-filter distribution at the
+    verify rows (row k is the bonus distribution).  ``accept_root`` /
+    ``resample_root``: the window's ``spec_window_keys`` roots;
+    ``row``: the request's draw id (``draw_base + batch row``), so
+    draws are batch-packing independent and capsule replay can re-pin
+    them.
+
+    Accept ``d_j`` w.p. ``min(1, p_j(d_j) / q_j(d_j))`` against
+    uniform ``u_j``; the first reject resamples from ``normalize(
+    max(p_j - q_j, 0))``.  Full acceptance draws the bonus from
+    ``p_k`` — the unified "reject at row k with q := 0" draw, keyed at
+    step k of the SAME resample chain.  Marginals equal the target's
+    post-filter distribution exactly (speculative-sampling identity),
+    for any proposal q.
+
+    Returns ``(tokens, n_accepted)`` like ``greedy_accept``."""
+    import jax
+    import jax.numpy as jnp
+
+    from .sampling import spec_draw_key
+
+    draft_toks = np.asarray(draft_toks)
+    k = int(draft_toks.shape[0])
+    q_probs = np.asarray(q_probs, np.float64)
+    p_probs = np.asarray(p_probs, np.float64)
+    enforce(p_probs.shape[0] == k + 1,
+            "rejection_accept wants k+1 verify rows for k draft tokens")
+    us = acceptance_uniforms(accept_root, k, row)
+    out = []
+    for j in range(k):
+        d = int(draft_toks[j])
+        ratio = p_probs[j, d] / max(q_probs[j, d], 1e-30)
+        if us[j] < min(1.0, ratio):
+            out.append(d)
+            continue
+        dist = residual_dist(p_probs[j], q_probs[j])
+        tok = int(np.asarray(jax.random.categorical(
+            spec_draw_key(resample_root, j, row),
+            jnp.log(jnp.asarray(dist, jnp.float32)))))
+        return out + [tok], j
+    # full acceptance: bonus row = "reject at k with q := 0", whose
+    # residual is p_k itself — same resample chain, step k
+    tok = int(np.asarray(jax.random.categorical(
+        spec_draw_key(resample_root, k, row),
+        jnp.log(jnp.asarray(p_probs[k], jnp.float32)))))
+    return out + [tok], k

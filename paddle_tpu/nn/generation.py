@@ -28,7 +28,8 @@ import numpy as np
 
 from ..common.errors import enforce
 
-__all__ = ["StaticCache", "GenerationMixin", "sample_logits"]
+__all__ = ["StaticCache", "GenerationMixin", "sample_logits",
+           "filtered_probs"]
 
 
 class StaticCache(NamedTuple):
@@ -104,6 +105,30 @@ def _top_p_filter(logits, p: float):
     thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
                      axis=-1, keepdims=True)
     return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def filtered_probs(logits, *, strategy: str = "greedy_search",
+                   top_k: int = 0, top_p: float = 1.0,
+                   temperature: float = 1.0):
+    """logits [B, V] -> the post-filter probabilities [B, V] f32 that
+    ``sample_logits`` draws its categorical from — SAME pipeline, same
+    order (temperature, top-k, top-p), so the returned distribution is
+    exactly the sampler's.  Greedy returns the degenerate one-hot on
+    the argmax.  Pure jax (usable inside scan) — this is the p/q
+    surface speculative decoding's rejection-acceptance step consumes
+    (inference/speculative.py)."""
+    if strategy == "greedy_search":
+        v = logits.shape[-1]
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                              dtype=jnp.float32)
+    filt = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        filt = filt / temperature
+    if top_k and top_k > 0:
+        filt = _top_k_filter(filt, top_k)
+    if top_p < 1.0:
+        filt = _top_p_filter(filt, top_p)
+    return jax.nn.softmax(filt, axis=-1)
 
 
 def sample_logits(logits, key, *, strategy: str = "greedy_search",

@@ -85,6 +85,13 @@ _TOKEN_AFFECTING = (
     # replay.  The dispatch MODE (grouped vs dense) is deliberately
     # inside neither — the two are bit-identical, like tp.
     "moe",
+    # speculative geometry (draft_hash/k/mode): a different draft
+    # proposes different tokens, which moves the SAMPLED stream (the
+    # acceptance draws walk different proposals) even though the
+    # greedy stream is draft-invariant by construction — replay
+    # across changed draft geometry reports, it does not silently
+    # pass.
+    "spec",
 )
 
 
@@ -118,7 +125,7 @@ class _NullCapsuleStore:
         pass
 
     def on_window(self, out, key_words, n_steps, steps_done, path,
-                  rows=None):
+                  rows=None, accepted=None):
         pass
 
     def annotate(self, rid, timeline=None, trace_id=None,
@@ -218,7 +225,8 @@ class CapsuleStore:
 
     def on_window(self, out: Dict[object, List[int]], key_words,
                   n_steps: int, steps_done: int, path: str,
-                  rows: Optional[Dict[object, int]] = None):
+                  rows: Optional[Dict[object, int]] = None,
+                  accepted: Optional[Dict[object, int]] = None):
         """Record one decode window for every captured rid it
         delivered tokens to: the window's forked key (the anchor of
         its in-window ``split_step`` chain), the STATIC dispatch size
@@ -228,18 +236,27 @@ class CapsuleStore:
         lets stochastic replay re-fold the request's exact per-row
         draw id whatever slot it decoded in (the carried row>0 gap);
         greedy replay never reads it.  The delivered tokens extend the
-        capsule's stream — the capsule always mirrors ``req.out``."""
+        capsule's stream — the capsule always mirrors ``req.out``.
+
+        Speculative windows (path ``"spec_window"``, ``n_steps = k_run
+        + 1``) additionally record the rid's ACCEPTED draft-token
+        count via ``accepted`` — the replay re-runs the whole
+        propose/verify/accept window and audits both the delivered
+        tokens and the acceptance length."""
         with self._lock:
             for rid, toks in out.items():
                 cap = self._ring.get(rid)
                 if cap is None:
                     continue
-                cap["windows"].append({
+                w = {
                     "key": key_words, "n_steps": int(n_steps),
                     "steps_done": int(steps_done),
                     "n_toks": len(toks), "path": path,
                     "row": int(rows[rid]) if rows and rid in rows
-                    else 0})
+                    else 0}
+                if accepted is not None and rid in accepted:
+                    w["accepted"] = int(accepted[rid])
+                cap["windows"].append(w)
                 cap["tokens"].extend(int(t) for t in toks)
 
     def annotate(self, rid, timeline=None, trace_id=None,
@@ -522,7 +539,9 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
     overshoot = max([w["n_steps"] for w in capsule.get("windows") or []]
                     + [int(engine.steps_per_sync)])
     slot = engine.cache.allocate(len(prompt) + len(exp) + overshoot)
-    try:
+    dslot = None    # scratch DRAFT slot, lazily attached at the first
+    try:            # sampled speculative window
+
         # full prefill, no prefix shortcut: replay must not depend on
         # what the prefix index currently holds (hits only skip
         # recompute of IDENTICAL pages, so running all chunks is the
@@ -563,13 +582,18 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
         # sampling walks the RECORDED windows so the split_step chain
         # replays key for key
         if strategy == "greedy_search":
+            # greedy replay never needs the spec windows re-run: the
+            # speculative greedy stream is BIT-IDENTICAL to plain
+            # decode by construction, so re-bucketing through the
+            # plain decode program audits exactly the same tokens —
+            # including capsules captured on a draft_model engine
             def plan():
                 j = i
                 while j < len(exp):
                     n = min(engine.steps_per_sync, len(exp) - j)
                     while n & (n - 1):
                         n &= n - 1
-                    yield n, n, jax.random.PRNGKey(0), 0
+                    yield n, n, jax.random.PRNGKey(0), 0, None
                     j += n
         else:
             # each window carries the batch ROW the request occupied
@@ -580,14 +604,64 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
                 for w in capsule.get("windows") or []:
                     yield w["n_steps"], w["n_toks"], \
                         _sampling.key_from_fingerprint(w["key"]), \
-                        int(w.get("row", 0))
+                        int(w.get("row", 0)), w
         pad = engine.max_seqs - 1
         padt = np.zeros((pad,) + engine.cache.page_table.shape[1:],
                         np.int32)
-        for n_steps, take, key, draw_row in plan():
+        for n_steps, take, key, draw_row, w in plan():
             if i >= len(exp) or take == 0:
                 continue
             take = min(take, len(exp) - i)
+            if w is not None and w.get("path") == "spec_window":
+                # sampled SPECULATIVE window: the recorded tokens came
+                # out of propose → verify → rejection-accept, so the
+                # audit re-runs the whole window through the SAME
+                # ``_spec_window`` entry with one scratch row — the
+                # recorded window key re-derives the draft / accept /
+                # resample roots, the recorded row re-pins every draw
+                if getattr(engine, "_spec", None) is None:
+                    report["notes"].append(
+                        "spec_windows_require_draft_engine")
+                    break
+                k_run = n_steps - 1
+                if k_run > engine.spec_k:
+                    report["notes"].append(
+                        f"spec_k_too_small_for_capsule:"
+                        f"{k_run}>{engine.spec_k}")
+                    break
+                if dslot is None:
+                    dslot = engine._spec_cache.allocate(
+                        len(prompt) + len(exp) + overshoot)
+                    engine._spec_prefill(dslot, prompt)
+                cur = len(prompt) + i - 1
+                (toks, a), = engine._spec_window(
+                    [{"slot": slot, "dslot": dslot,
+                      "last": exp[i - 1], "cur": cur,
+                      "seq": prompt + exp, "row": draw_row}],
+                    key, k_run)
+                if "accepted" in w and int(a) != int(w["accepted"]):
+                    report["notes"].append(
+                        f"accepted_len_mismatch@{i}:"
+                        f"want={int(w['accepted'])},got={int(a)}")
+                for j in range(take):
+                    report["steps_compared"] += 1
+                    got_j = int(toks[j]) if j < len(toks) else -1
+                    if got_j != exp[i + j]:
+                        _divergence(report, i + j, exp[i + j], got_j)
+                        st.record_replay(report)
+                        return report
+                # re-align both scratch slots with the VERIFIED
+                # stream: a live request may have truncated the
+                # delivery at EOS / max_new
+                extra = len(toks) - take
+                if extra > 0:
+                    engine.cache.rollback(slot, extra)
+                over = int(engine._spec_cache.seq_lens[dslot]) - \
+                    (cur + take)
+                if over > 0:
+                    engine._spec_cache.rollback(dslot, over)
+                i += take
+                continue
             if i == 0:
                 # unanchored first token (begin_request capsules): the
                 # live run derived it from the prompt's last logits
@@ -666,6 +740,8 @@ def replay_capsule(capsule: dict, engine, *, logprobs: bool = True,
         return report
     finally:
         engine.cache.release(slot)
+        if dslot is not None:
+            engine._spec_cache.release(dslot)
 
 
 def _context_logits(engine, context):

@@ -779,6 +779,24 @@ class ReplicaRouter:
                 "imbalance": (max(tok) / (total / width)
                               if total else 0.0),
             }
+        # speculative-plane federation: proposed / accepted /
+        # delivered summed across replicas, acceptance rate recomputed
+        # from the merged counters (a mean of per-replica rates would
+        # weight an idle replica the same as a saturated one)
+        specs = [(s.get("engine") or {}).get("spec") for s in fresh]
+        specs = [m for m in specs if m]
+        if specs:
+            prop = sum(int(m.get("proposed", 0) or 0) for m in specs)
+            acc = sum(int(m.get("accepted", 0) or 0) for m in specs)
+            fleet["spec"] = {
+                "windows": sum(int(m.get("windows", 0) or 0)
+                               for m in specs),
+                "proposed": prop,
+                "accepted": acc,
+                "delivered": sum(int(m.get("delivered", 0) or 0)
+                                 for m in specs),
+                "acceptance_rate": (acc / prop if prop else 0.0),
+            }
         out = {"router": self.router_id, "retries": self.retry_count,
                "ejected": sorted(self._ejected),
                "replicas": rows, "fleet": fleet}

@@ -486,7 +486,11 @@ class Scheduler:
         multi-token windows (``scan_decode``) divide by the tokens the
         window actually delivered — the max over
         ``len(step_out[rid])`` — so an early-exited window is costed
-        by its real length.  Breach: halve (floor 1 — the engine's own
+        by its real length.  Speculative windows fall out of the same
+        rule: ``step_out`` carries only ACCEPTED (delivered) tokens,
+        so a low-acceptance draft reads as HIGH per-token cost and
+        sheds prefill interleave instead of hiding behind proposed-
+        but-rejected tokens.  Breach: halve (floor 1 — the engine's own
         livelock guard still guarantees prefill progress on
         prefill-only steps).  Under SLO: recover one page per step up
         to the configured ceiling (``engine._pf_budget_static``)."""

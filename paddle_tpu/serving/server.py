@@ -423,6 +423,10 @@ class HTTPFrontend:
             # snapshot, router targets federate via /fleetz)
             "moe": eng.get("moe") if isinstance(eng, dict)
             else snap.get("moe"),
+            # speculative decoding: acceptance headline (None without
+            # a draft_model; router targets federate via /fleetz)
+            "spec": eng.get("spec") if isinstance(eng, dict)
+            else snap.get("spec"),
             "ttft_seconds": self._ttft_view(eng),
         }
         tr = _tracing.get_tracer()

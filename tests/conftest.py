@@ -104,17 +104,20 @@ def _reset_capsule_store():
 
 @pytest.fixture(autouse=True)
 def _decode_window_zero_recompiles(request):
-    """Scanned-window tests (the ``decode_window`` suite) must leave
-    ZERO ``jit_recompile_events_total`` on the warm engine: the
-    on-device window's power-of-two buckets are DECLARED CompileWatch
-    allowances, so any recompile a window test provokes is an anomaly
+    """Scanned-window tests (the ``decode_window`` and
+    ``speculative`` suites) must leave ZERO
+    ``jit_recompile_events_total`` on the warm engine: the on-device
+    window's power-of-two buckets — and the speculative draft /
+    verify programs — are DECLARED CompileWatch allowances, so any
+    recompile such a test provokes is an anomaly
     — asserted here, after the test body but before
     ``_reset_compile_watch`` disables the watch (this fixture is
     declared later, so its teardown runs first).  Scoped by nodeid so
     tests that exercise recompiles ON PURPOSE (test_introspection)
     stay out of its jurisdiction."""
     yield
-    if "decode_window" not in request.node.nodeid:
+    if "decode_window" not in request.node.nodeid and \
+            "speculative" not in request.node.nodeid:
         return
     from paddle_tpu.observability.introspection import get_compile_watch
     snap = get_compile_watch().snapshot()
